@@ -1,0 +1,11 @@
+"""Core primitives: config dictionary, schemas, columnar batches."""
+
+from .config import SettingDictionary, SettingNamespace, parse_duration_seconds
+from .confmanager import ConfigManager
+
+__all__ = [
+    "SettingDictionary",
+    "SettingNamespace",
+    "parse_duration_seconds",
+    "ConfigManager",
+]

@@ -1,0 +1,17 @@
+"""Test harness: run JAX on a virtual 8-device CPU mesh.
+
+Must set the env vars before the first ``import jax`` anywhere in the test
+process so sharding tests can exercise real multi-device code paths without
+TPU hardware. x64 is deliberately left OFF to match TPU numerics (the
+framework keeps device time columns as int32 millis relative to a host-side
+batch base instead of int64 epochs).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

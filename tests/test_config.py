@@ -1,0 +1,141 @@
+"""Config system tests.
+
+Mirrors the namespace/grouping semantics of the reference's
+SettingDictionary (SettingDictionary.scala:20-150) and ConfigManager conf
+parsing (ConfigManager.scala:98-135).
+"""
+
+import pytest
+
+from data_accelerator_tpu.core.config import (
+    EngineException,
+    SettingDictionary,
+    SettingNamespace,
+    parse_conf_lines,
+    parse_duration_seconds,
+    replace_tokens,
+)
+from data_accelerator_tpu.core.confmanager import ConfigManager, get_named_args
+
+
+SAMPLE = {
+    "datax.job.name": "HomeAutomationLocal",
+    "datax.job.input.default.blobschemafile": "schema.json",
+    "datax.job.input.default.eventhub.maxrate": "100",
+    "datax.job.input.default.streaming.intervalinseconds": "2",
+    "datax.job.process.transform": "t.transform",
+    "datax.job.process.watermark": "0 second",
+    "datax.job.process.timewindow.DataXProcessedInput_5minutes.windowduration": "5 minutes",
+    "datax.job.output.Metrics.metric": "",
+    "datax.job.output.alerts.blob.compressiontype": "none",
+    "datax.job.output.alerts.blob.group.main.folder": "/out",
+}
+
+
+def make_dict():
+    return SettingDictionary(dict(SAMPLE))
+
+
+def test_basic_getters():
+    d = make_dict()
+    assert d.get_string("datax.job.name") == "HomeAutomationLocal"
+    assert d.get_int_option("datax.job.input.default.eventhub.maxrate") == 100
+    assert d.get("missing") is None
+    with pytest.raises(EngineException):
+        d.get_string("missing")
+
+
+def test_sub_dictionary_strips_prefix():
+    d = make_dict()
+    sub = d.get_sub_dictionary(SettingNamespace.JobInputPrefix)
+    assert sub.get_string("blobschemafile") == "schema.json"
+    assert sub.get_int_option("eventhub.maxrate") == 100
+    # error messages carry the full path
+    with pytest.raises(EngineException, match="datax.job.input.default.nope"):
+        sub.get_string("nope")
+
+
+def test_group_by_sub_namespace():
+    d = make_dict()
+    outputs = d.get_sub_dictionary(SettingNamespace.JobOutputPrefix)
+    groups = outputs.group_by_sub_namespace()
+    assert set(groups) == {"Metrics", "alerts"}
+    assert groups["Metrics"].get("metric") == ""
+    assert (
+        groups["alerts"].get_string("blob.compressiontype") == "none"
+    )
+
+
+def test_group_default_setting_key():
+    # key equal to the namespace itself becomes the "" default setting
+    # (reference: SettingDictionary.scala:59-67)
+    d = SettingDictionary({"sink": "console", "sink.path": "/tmp"})
+    groups = d.group_by_sub_namespace()
+    assert groups["sink"].get_default() == "console"
+    assert groups["sink"].get_string("path") == "/tmp"
+
+
+def test_group_by_sub_namespace_with_prefix():
+    d = make_dict()
+    wins = d.group_by_sub_namespace("datax.job.process.timewindow.")
+    assert list(wins) == ["DataXProcessedInput_5minutes"]
+    assert wins["DataXProcessedInput_5minutes"].get_duration("windowduration") == 300.0
+
+
+def test_durations():
+    assert parse_duration_seconds("5 minutes") == 300.0
+    assert parse_duration_seconds("0 second") == 0.0
+    assert parse_duration_seconds("60") == 60.0
+    assert parse_duration_seconds("1 hour") == 3600.0
+    assert parse_duration_seconds("500 ms") == 0.5
+    with pytest.raises(EngineException):
+        parse_duration_seconds("five minutes")
+
+
+def test_conf_lines_parse_and_tokens():
+    lines = [
+        "# comment",
+        "",
+        "datax.job.name=myjob",
+        "datax.job.process.transform=${folder}/t.transform",
+        "datax.job.flagonly",
+    ]
+    props = parse_conf_lines(lines, {"folder": "/cfg"})
+    assert props["datax.job.name"] == "myjob"
+    assert props["datax.job.process.transform"] == "/cfg/t.transform"
+    assert props["datax.job.flagonly"] is None
+
+
+def test_replace_tokens_literal():
+    assert replace_tokens("a ${x} b", {"x": "1"}) == "a 1 b"
+    assert replace_tokens(None, {"x": "1"}) is None
+    assert replace_tokens("${y}", {}) == "${y}"
+
+
+def test_config_manager_cli_env(monkeypatch, tmp_path):
+    ConfigManager.reset()
+    monkeypatch.setenv("DATAX_APPNAME", "envapp")
+    conf = tmp_path / "job.conf"
+    conf.write_text(
+        "datax.job.name=fromfile\n"
+        "datax.job.process.transform=${DATAX_APPNAME}.transform\n"
+    )
+    d = ConfigManager.get_configuration_from_arguments([f"conf={conf}"])
+    assert d.get_app_configuration_file() == str(conf)
+    d = ConfigManager.load_config()
+    assert d.get_job_name() == "fromfile"
+    # ${token} substitution draws from the merged env+cli dictionary
+    assert d.get_string("datax.job.process.transform") == "envapp.transform"
+    assert d.get_metric_app_name() == "DATAX-fromfile"
+    ConfigManager.reset()
+
+
+def test_named_args():
+    assert get_named_args(["a=1", "b = 2", "noval"]) == {"a": "1", "b": "2"}
+
+
+def test_missing_conf_raises():
+    ConfigManager.reset()
+    with pytest.raises(EngineException):
+        ConfigManager.get_configuration_from_arguments(["x=1"])
+    ConfigManager.reset()
