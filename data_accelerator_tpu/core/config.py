@@ -247,7 +247,9 @@ class SettingDictionary:
 
     # -- well-known settings --------------------------------------------
     def get_app_name(self) -> str:
-        return self.elems.get(JobArgument.ConfName_AppName, "DataX_Unknown_App")
+        return self.elems.get(
+            JobArgument.ConfName_AppName, ProductConstant.DefaultAppName
+        )
 
     def get_job_name(self) -> str:
         return self.elems.get(SettingNamespace.JobNameFullPath, self.get_app_name())
@@ -284,7 +286,10 @@ def parse_conf_lines(
         elif pos > 0:
             key, value = stripped[:pos].strip(), stripped[pos + 1:].strip()
         else:
-            key, value = stripped, None
+            # flag-only line: store empty string so the key still registers
+            # as present (the reference keeps the key with a null value;
+            # features are switched purely by key presence)
+            key, value = stripped, ""
         out[key] = replace_tokens(value, replacements)
     return out
 

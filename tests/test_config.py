@@ -103,7 +103,7 @@ def test_conf_lines_parse_and_tokens():
     props = parse_conf_lines(lines, {"folder": "/cfg"})
     assert props["datax.job.name"] == "myjob"
     assert props["datax.job.process.transform"] == "/cfg/t.transform"
-    assert props["datax.job.flagonly"] is None
+    assert props["datax.job.flagonly"] == ""
 
 
 def test_replace_tokens_literal():
