@@ -1,0 +1,17 @@
+"""Flow compiler: DataXQuery parsing, rules codegen, SQL planning, flattening."""
+
+from .transform_parser import (
+    SqlCommand,
+    ParsedResult,
+    TransformParser,
+    COMMAND_TYPE_QUERY,
+    COMMAND_TYPE_COMMAND,
+)
+
+__all__ = [
+    "SqlCommand",
+    "ParsedResult",
+    "TransformParser",
+    "COMMAND_TYPE_QUERY",
+    "COMMAND_TYPE_COMMAND",
+]

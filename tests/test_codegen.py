@@ -1,0 +1,181 @@
+"""Rules codegen tests, modeled on the reference's golden-pair suite
+(DataX.Flow.CodegenRules.Tests/CodegenTests.cs + UserCode*/CGen* pairs).
+Assertions are semantic (what queries/outputs/windows/tables are produced)
+rather than whitespace-exact.
+"""
+
+import json
+
+from data_accelerator_tpu.compile.codegen import CodegenEngine, Rule
+from data_accelerator_tpu.compile.transform_parser import TransformParser
+
+SIMPLE_ALERT_RULE = {
+    "$ruleId": "R100",
+    "$productId": "iotsample",
+    "$ruleType": "SimpleRule",
+    "$ruleDescription": "DoorLock Close",
+    "$severity": "Critical",
+    "$condition": "deviceDetails.deviceType = 'DoorLock' AND deviceDetails.status = 1",
+    "$tagname": "Tag",
+    "$tag": "CLOSE",
+    "$isAlert": True,
+    "$alertsinks": ["Metrics"],
+    "schemaTableName": "DataXProcessedInput",
+}
+
+AGG_ALERT_RULE = {
+    "$ruleId": "R3",
+    "$productId": "iotsample",
+    "$ruleType": "AggregateRule",
+    "$ruleDescription": "Hot average",
+    "$severity": "Critical",
+    "$aggs": ["AVG(Temperature)", "MAX(Temperature)"],
+    "$condition": "AVG(Temperature) > 90",
+    "$pivots": ["DeviceId", "Geo"],
+    "$tagname": "Tag",
+    "$tag": "HotAvg",
+    "$isAlert": True,
+    "$alertsinks": ["Metrics"],
+    "schemaTableName": "DataXProcessedInput",
+}
+
+
+def gen(code, rules, product="iotsample"):
+    return CodegenEngine().generate_code(code, json.dumps(rules), product)
+
+
+def test_simple_alert_autogen_and_expansion():
+    # no explicit ProcessAlerts call: AutoCodegenAlerts appends one
+    rc = gen("--DataXQuery--\nt1 = SELECT * FROM DataXProcessedInput;", [SIMPLE_ALERT_RULE])
+    code = rc.code
+    assert "ProcessAlerts" not in code
+    assert "sa1_1_1 = SELECT *, 'R100' AS ruleId" in code
+    assert "WHERE deviceDetails.deviceType = 'DoorLock' AND deviceDetails.status = 1" in code
+    # no non-Metrics alertsinks -> sa2 kept but its OUTPUT dropped
+    assert "sa2_1_1 = SELECT * FROM sa1_1_1" in code
+    assert ("CLOSEAlert", "Metrics") in rc.outputs
+    assert not any(t == "sa2_1_1" for t, _ in rc.outputs)
+    # alert metric uses the DirectTable widget
+    srcs = rc.metrics_root["metrics"]["sources"]
+    assert srcs and srcs[0]["input"]["type"] == "MetricDetailsApi"
+    assert srcs[0]["input"]["metricKeys"][0]["name"] == "_FLOW_:CLOSEAlert"
+
+
+def test_simple_alert_with_external_sinks():
+    rule = dict(SIMPLE_ALERT_RULE)
+    rule["$alertsinks"] = ["myCosmos", "Metrics"]
+    rc = gen("", [rule])
+    assert ("sa2_1_1", "myCosmos") in rc.outputs
+    assert ("CLOSEAlert", "Metrics") in rc.outputs
+
+
+def test_process_rules_array_conditions():
+    rule = dict(SIMPLE_ALERT_RULE)
+    rule["$isAlert"] = False
+    rc = gen("--DataXQuery--\nRules = ProcessRules(DataXProcessedInput);", [rule])
+    assert "Rules = SELECT *, filterNull(Array(IF(" in rc.code
+    assert "'ruleId', 'R100'" in rc.code
+
+
+def test_process_rules_no_match_is_null():
+    rc = gen("--DataXQuery--\nRules = ProcessRules(DataXProcessedInput);", [])
+    assert "Rules = SELECT *, 'NULL' AS Rules FROM DataXProcessedInput" in rc.code
+
+
+def test_aggregate_alert():
+    rc = gen("", [AGG_ALERT_RULE])
+    code = rc.code
+    assert (
+        "aa1_1_1 = SELECT AVG(Temperature) AS Temperature_AVG, MAX(Temperature) AS Temperature_MAX,"
+        " DeviceId, Geo, COUNT(*) AS Count" in code
+    )
+    assert "GROUP BY DeviceId, Geo" in code
+    # condition rewritten to the alias
+    assert "WHERE Temperature_AVG > 90" in code
+    # default agg output template applied
+    assert "MAP('Temperature', MAP('AVG', Temperature_AVG, 'MAX', Temperature_MAX)) AS aggs" in code
+    assert ("HotAvgAlert", "Metrics") in rc.outputs
+
+
+def test_create_metric_expansion():
+    rc = gen(
+        "--DataXQuery--\nHeaterStateOneIsOn = CreateMetric(HeaterStateFiltered, status);",
+        [],
+    )
+    assert (
+        "HeaterStateOneIsOn = SELECT DISTINCT DATE_TRUNC('second', current_timestamp()) AS EventTime,"
+        " 'HeaterStateOneIsOn' AS MetricName, status AS Metric, 'iotsample' AS Product" in rc.code
+    )
+
+
+def test_timewindow_rewrite():
+    code = (
+        "--DataXQuery--\nDeviceWindowedInput = SELECT deviceId FROM DataXProcessedInput\n"
+        "TIMEWINDOW('5 minutes')\nGROUP BY deviceId;"
+    )
+    rc = gen(code, [])
+    assert rc.time_windows == {"DataXProcessedInput_5minutes": "5 minutes"}
+    assert "FROM DataXProcessedInput_5minutes" in rc.code
+    assert "TIMEWINDOW" not in rc.code
+
+
+def test_accumulation_table_and_upsert():
+    code = (
+        "--DataXStates--\n"
+        "CREATE TABLE acc_t (deviceId long, EventTime Timestamp, Reading long);\n"
+        "--DataXQuery--\n"
+        "t1 = SELECT deviceId, EventTime, Reading FROM DataXProcessedInput\n"
+        "UNION ALL SELECT deviceId, EventTime, Reading FROM acc_t;\n"
+        "--DataXQuery--\n"
+        "SELECT * FROM t1 WITH UPSERT acc_t;\n"
+    )
+    rc = gen(code, [])
+    assert rc.accumulation_tables == {
+        "acc_t": "deviceId long, EventTime Timestamp, Reading long"
+    }
+    assert "acc_t = SELECT * FROM t1" in rc.code
+    assert "WITH UPSERT" not in rc.code
+    assert "CREATE TABLE" not in rc.code
+
+
+def test_outputs_extracted_and_multi():
+    code = (
+        "--DataXQuery--\nA = SELECT 1;\n--DataXQuery--\nB = SELECT 2;\n"
+        "OUTPUT A TO Metrics;\nOUTPUT A, B TO myBlob;\n"
+    )
+    rc = gen(code, [])
+    assert ("A", "Metrics") in rc.outputs
+    assert ("A, B", "myBlob") in rc.outputs
+    assert "OUTPUT" not in rc.code
+
+
+def test_generated_code_parses():
+    # end-to-end: codegen output must round-trip through the transform parser
+    code = (
+        "--DataXQuery--\nDeviceWindowedInput = SELECT deviceId FROM DataXProcessedInput\n"
+        "TIMEWINDOW('5 minutes')\nGROUP BY deviceId;\n"
+        "--DataXQuery--\nRules = ProcessRules(DataXProcessedInput);\n"
+        "OUTPUT Rules TO Metrics;"
+    )
+    rc = gen(code, [SIMPLE_ALERT_RULE])
+    parsed = TransformParser.parse_text(rc.code)
+    names = [c.name for c in parsed.commands if c.name]
+    assert "DeviceWindowedInput" in names
+    assert "Rules" in names
+    assert "sa1_2_1" in names or "sa1_1_1" in names
+
+
+def test_rule_helpers_backtick_and_dots():
+    r = Rule.from_json(
+        {
+            "$ruleType": "AggregateRule",
+            "$aggs": ["min(`device.msg.received`)", "AVG(a.b)"],
+            "$pivots": ["device.status.home"],
+            "$condition": "min(`device.msg.received`) > 1",
+        }
+    )
+    assert r.aggs_to_select() == (
+        "min(`device.msg.received`) AS `device.msg.received_min`, AVG(a.b) AS ab_AVG"
+    )
+    assert r.condition_to_sql() == "`device.msg.received_min` > 1"
+    assert r.pivots_to_template() == "'device.status.home', home"

@@ -1,0 +1,148 @@
+"""Flattener tests, covering all mapping node types against the semantics
+of the reference golden triple
+(DataX.Config.Test/Resource/Flattener/{input.json,config.json,output.conf}).
+"""
+
+from data_accelerator_tpu.compile.flattener import ConfigFlattener
+from data_accelerator_tpu.compile.flattener_schema import DEFAULT_FLATTENER_SCHEMA
+
+SCHEMA = {
+    "type": "object",
+    "namespace": "root.ns",
+    "fields": {
+        "plain": "plain",
+        "nested": {
+            "type": "object",
+            "namespace": "nested",
+            "fields": {"inner": "inner"},
+        },
+        "arr": {
+            "type": "array",
+            "namespace": "arr",
+            "element": {
+                "type": "scopedObject",
+                "namespaceField": "name",
+                "fields": {"val": "val"},
+            },
+        },
+        "m": {"type": "map", "namespace": "m", "fields": {"v": "v"}},
+        "sl": {"type": "stringList", "namespace": "sl"},
+        "props": {"type": "mapProps", "namespace": "prop"},
+        "defaulted": {
+            "type": "excludeDefaultValue",
+            "namespace": "defaulted",
+            "defaultValue": "gzip",
+        },
+    },
+}
+
+DOC = {
+    "plain": "a",
+    "nested": {"inner": "b"},
+    "arr": [{"name": "e1", "val": "v1"}, {"name": "e2", "val": "v2"}],
+    "m": {"k1": {"v": "m1"}, "k2": {"v": "m2"}},
+    "sl": ["s1", "s2"],
+    "props": {"p1": "x", "p2": "y"},
+    "defaulted": "gzip",
+}
+
+
+def test_all_node_types():
+    flat = ConfigFlattener(SCHEMA).flatten(DOC)
+    assert flat == {
+        "root.ns.plain": "a",
+        "root.ns.nested.inner": "b",
+        "root.ns.arr.e1.val": "v1",
+        "root.ns.arr.e2.val": "v2",
+        "root.ns.m.k1.v": "m1",
+        "root.ns.m.k2.v": "m2",
+        "root.ns.sl": "s1;s2",
+        "root.ns.prop.p1": "x",
+        "root.ns.prop.p2": "y",
+        # defaulted == defaultValue -> excluded
+    }
+
+
+def test_non_default_value_kept():
+    flat = ConfigFlattener(SCHEMA).flatten({"defaulted": "none"})
+    assert flat == {"root.ns.defaulted": "none"}
+
+
+def test_default_schema_home_automation_shape():
+    # the job template shape used by flow documents
+    # (DeploymentLocal/sample/HomeAutomationLocal.json commonProcessor.template)
+    doc = {
+        "name": "HomeAutomationLocal",
+        "input": {
+            "eventhub": {"maxRate": "100"},
+            "streaming": {"intervalInSeconds": "2"},
+            "blobSchemaFile": "schema.json",
+            "referenceData": [
+                {
+                    "name": "myDevicesRefdata",
+                    "path": "/app/devices.csv",
+                    "format": "csv",
+                    "header": True,
+                    "delimiter": ",",
+                }
+            ],
+        },
+        "process": {
+            "metric": {"httppost": "http://localhost:2020/api/data/upload"},
+            "timestampColumn": "eventTimeStamp",
+            "watermark": "0 second",
+            "transform": "ha.transform",
+            "projections": ["p1.projection", "p2.projection"],
+            "timeWindows": [
+                {"name": "DataXProcessedInput_5minutes", "windowDuration": "5 minutes"}
+            ],
+            "jarUDFs": [
+                {
+                    "name": "whoOpened",
+                    "class": "datax.sample.udf.UdfHelloWorld",
+                    "path": "/bin/samples.jar",
+                    "libs": [],
+                }
+            ],
+            "accumulationTables": [
+                {"name": "acc_t", "schema": "deviceId long", "location": "/st"}
+            ],
+        },
+        "outputs": [
+            {"name": "Metrics", "metric": ""},
+            {
+                "name": "myBlob",
+                "blob": {
+                    "compressionType": "gzip",
+                    "groups": {"main": {"folder": "/out"}},
+                },
+            },
+        ],
+    }
+    flat = ConfigFlattener(DEFAULT_FLATTENER_SCHEMA).flatten(doc)
+    assert flat["datax.job.name"] == "HomeAutomationLocal"
+    assert flat["datax.job.input.default.eventhub.maxrate"] == "100"
+    assert flat["datax.job.input.default.streaming.intervalinseconds"] == "2"
+    assert flat["datax.job.input.default.referencedata.myDevicesRefdata.path"] == "/app/devices.csv"
+    assert flat["datax.job.input.default.referencedata.myDevicesRefdata.header"] == "true"
+    assert flat["datax.job.process.watermark"] == "0 second"
+    assert flat["datax.job.process.projection"] == "p1.projection;p2.projection"
+    assert (
+        flat["datax.job.process.timewindow.DataXProcessedInput_5minutes.windowduration"]
+        == "5 minutes"
+    )
+    assert flat["datax.job.process.jar.udf.whoOpened.class"] == "datax.sample.udf.UdfHelloWorld"
+    assert flat["datax.job.process.statetable.acc_t.schema"] == "deviceId long"
+    assert flat["datax.job.output.Metrics.metric"] == ""
+    assert flat["datax.job.output.myBlob.blob.group.main.folder"] == "/out"
+    # gzip is the default compression -> excluded
+    assert "datax.job.output.myBlob.blob.compressiontype" not in flat
+
+
+def test_flatten_to_conf_round_trip():
+    from data_accelerator_tpu.core.config import parse_conf_lines
+
+    conf_text = ConfigFlattener(SCHEMA).flatten_to_conf(DOC)
+    parsed = parse_conf_lines(conf_text.split("\n"))
+    assert parsed["root.ns.sl"] == "s1;s2"
+    assert parsed["root.ns.arr.e1.val"] == "v1"

@@ -1,0 +1,78 @@
+"""DataXQuery transform parser tests.
+
+The first test is the reference's own parser test case
+(datax-host TransformSQLParserTests.scala:11-21) — same input, same
+expected commands and view reference counts.
+"""
+
+import pytest
+
+from data_accelerator_tpu.compile import (
+    COMMAND_TYPE_COMMAND,
+    COMMAND_TYPE_QUERY,
+    TransformParser,
+)
+from data_accelerator_tpu.core.config import EngineException
+
+IOT_SQL = (
+    "--DataXQuery--\niottestbatch5s = \nSELECT MIN(myTime) AS __receivedtime,\n"
+    "      '00000000-0000-0000-0000-000000000000' AS __ruleid,\n\tIoTDeviceId AS __deviceid,\n"
+    "        MAP('avg', AVG(temperature), 'max', MAX(temperature), 'min', MIN(temperature),"
+    " 'count', COUNT(temperature)) AS temperature\nFROM DataXProcessedInput\nGROUP BY IoTDeviceId\n"
+    "--DataXQuery--\niottestbatch5salert = \nSELECT 1 AS `doc.schemaversion`,\n\t'alarm' AS `doc.schema`,\n"
+    "\t'open' AS status,\n\t'1Rule-1Device-NMessage' AS logic,\n\tunix_timestamp()*1000 AS created,\n"
+    "\tunix_timestamp()*1000 AS modified,\n\t'Temperature > 80 degrees' AS `rule.description`,\n"
+    "\t'Critical' AS `rule.severity`,\n\t__ruleid AS `rule.id`,\n\t__deviceid AS `device.id`,\n"
+    "\tSTRUCT(__ruleid, __deviceid, temperature) AS __aggregates,\n"
+    "   \t__receivedtime AS `device.msg.received`\nFROM iottestbatch5s\nWHERE temperature.avg>0"
+)
+
+
+def test_reference_iot_case():
+    result = TransformParser.parse(IOT_SQL.split("\n"))
+    assert len(result.commands) == 2
+    c0, c1 = result.commands
+    assert c0.name == "iottestbatch5s"
+    assert c0.command_type == COMMAND_TYPE_QUERY
+    assert c0.text.startswith("SELECT MIN(myTime) AS __receivedtime,")
+    assert "GROUP BY IoTDeviceId" in c0.text
+    assert c1.name == "iottestbatch5salert"
+    assert "FROM iottestbatch5s" in c1.text
+    assert result.view_reference_count == {
+        "iottestbatch5s": 1,
+        "iottestbatch5salert": 0,
+    }
+
+
+def test_command_without_assignment():
+    r = TransformParser.parse_text(
+        "--DataXQuery--\nt1 = SELECT 1\n--DataXQuery--\nCACHE TABLE t1"
+    )
+    assert r.commands[1].name is None
+    assert r.commands[1].command_type == COMMAND_TYPE_COMMAND
+    # reference counts are only bumped by named queries
+    # (TransformSqlParser.scala:36-46)
+    assert r.view_reference_count["t1"] == 0
+
+
+def test_comments_skipped():
+    r = TransformParser.parse_text(
+        "--DataXQuery--\n-- a comment line\nt1 = SELECT 1\n-- trailing comment"
+    )
+    assert len(r.commands) == 1
+    assert r.commands[0].text == "SELECT 1"
+
+
+def test_duplicate_view_raises():
+    with pytest.raises(EngineException, match="t1"):
+        TransformParser.parse_text(
+            "--DataXQuery--\nt1 = SELECT 1\n--DataXQuery--\nt1 = SELECT 2"
+        )
+
+
+def test_replace_table_names():
+    s = TransformParser.replace_table_names(
+        "SELECT * FROM tbl JOIN tbl2 ON tbl.x = tbl2.x",
+        {"tbl": "tbl_w"},
+    )
+    assert s == "SELECT * FROM tbl_w JOIN tbl2 ON tbl_w.x = tbl2.x"
