@@ -1,0 +1,190 @@
+"""Columnar micro-batch: the device-resident unit of streaming data.
+
+Where the reference engine's unit is a Spark ``DataFrame`` of rows, the
+TPU-native unit is a fixed-capacity struct-of-arrays with a validity mask.
+Static shapes are what let XLA compile the whole flow pipeline once and
+reuse it every batch (reference hot path analog:
+CommonProcessorFactory.scala:333-399 processDataset).
+
+A ``Batch`` is a registered pytree: column arrays + validity mask + the
+scalar ``base_ms`` are traced leaves; the column ordering is static
+structure. String columns hold int32 dictionary ids (see
+``core.schema.StringDictionary``); timestamps are int32 ms since
+``base_ms``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .schema import ColType, Column, Schema, StringDictionary
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class Batch:
+    """Fixed-capacity columnar batch.
+
+    columns: name -> [capacity] array (int32/float32/bool)
+    valid:   [capacity] bool mask of live rows
+    base_ms: scalar int64-on-host epoch-ms origin for TIMESTAMP columns,
+             carried as a traced float32 scalar (seconds precision is
+             enough for window/bookkeeping math on device).
+    """
+
+    columns: Dict[str, jnp.ndarray]
+    valid: jnp.ndarray
+    base_ms: jnp.ndarray  # scalar float32: epoch seconds of the batch origin
+
+    def tree_flatten(self):
+        names = tuple(sorted(self.columns))
+        children = tuple(self.columns[n] for n in names) + (self.valid, self.base_ms)
+        return children, names
+
+    @classmethod
+    def tree_unflatten(cls, names, children):
+        cols = dict(zip(names, children[: len(names)]))
+        valid, base_ms = children[len(names)], children[len(names) + 1]
+        return cls(cols, valid, base_ms)
+
+    # -- basic props -----------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return int(self.valid.shape[0])
+
+    @property
+    def names(self) -> List[str]:
+        return list(self.columns)
+
+    def count(self) -> jnp.ndarray:
+        """Number of live rows (traced scalar)."""
+        return jnp.sum(self.valid.astype(jnp.int32))
+
+    def with_columns(self, columns: Dict[str, jnp.ndarray]) -> "Batch":
+        return Batch(columns, self.valid, self.base_ms)
+
+    def with_valid(self, valid: jnp.ndarray) -> "Batch":
+        return Batch(self.columns, valid, self.base_ms)
+
+    def select(self, names: Sequence[str]) -> "Batch":
+        return self.with_columns({n: self.columns[n] for n in names})
+
+
+def empty_batch(schema: Schema, capacity: int, base_ms: float = 0.0) -> Batch:
+    cols = {
+        c.name: jnp.zeros((capacity,), dtype=c.ctype.np_dtype) for c in schema.columns
+    }
+    return Batch(
+        cols,
+        jnp.zeros((capacity,), dtype=jnp.bool_),
+        jnp.asarray(base_ms / 1000.0, dtype=jnp.float32),
+    )
+
+
+def batch_from_rows(
+    rows: List[dict],
+    schema: Schema,
+    capacity: int,
+    dictionary: StringDictionary,
+    base_ms: Optional[int] = None,
+) -> Batch:
+    """Host-side encode of JSON-like row dicts into a device batch.
+
+    Nested dicts are addressed by the schema's dotted paths. Rows beyond
+    ``capacity`` are dropped (the runtime's ingest chunker prevents this).
+    This is the pure-Python fallback path; the C++ decoder in
+    ``native/`` produces the same buffers for the hot ingest path.
+    """
+    n = min(len(rows), capacity)
+    if base_ms is None:
+        base_ms = 0
+        for r in rows[:n]:
+            ts = _first_timestamp(r, schema)
+            if ts is not None:
+                base_ms = ts
+                break
+
+    arrays: Dict[str, np.ndarray] = {}
+    for col in schema.columns:
+        arr = np.zeros((capacity,), dtype=col.ctype.np_dtype)
+        for i in range(n):
+            v = _dig(rows[i], col.name)
+            if v is None:
+                continue
+            if col.ctype == ColType.STRING:
+                arr[i] = dictionary.encode(str(v))
+            elif col.ctype == ColType.TIMESTAMP:
+                arr[i] = np.int32(int(v) - base_ms)
+            elif col.ctype == ColType.BOOLEAN:
+                arr[i] = bool(v)
+            elif col.ctype == ColType.LONG:
+                arr[i] = np.int32(int(v))
+            else:
+                arr[i] = np.float32(v)
+        arrays[col.name] = arr
+
+    valid = np.zeros((capacity,), dtype=np.bool_)
+    valid[:n] = True
+    return Batch(
+        {k: jnp.asarray(v) for k, v in arrays.items()},
+        jnp.asarray(valid),
+        jnp.asarray(base_ms / 1000.0, dtype=jnp.float32),
+    )
+
+
+def batch_to_rows(
+    batch: Batch,
+    dictionary: StringDictionary,
+    schema_types: Optional[Dict[str, ColType]] = None,
+    max_rows: Optional[int] = None,
+) -> List[dict]:
+    """Device -> host rows (only valid rows), decoding dictionary ids and
+    restoring absolute timestamps. Used by sinks and LiveQuery display."""
+    host_cols = {k: np.asarray(v) for k, v in batch.columns.items()}
+    valid = np.asarray(batch.valid)
+    base_ms = int(round(float(np.asarray(batch.base_ms)) * 1000.0))
+    idx = np.nonzero(valid)[0]
+    if max_rows is not None:
+        idx = idx[:max_rows]
+    types = schema_types or {}
+    out = []
+    for i in idx:
+        row = {}
+        for name, arr in host_cols.items():
+            v = arr[i]
+            ctype = types.get(name)
+            if ctype == ColType.STRING:
+                row[name] = dictionary.decode(int(v))
+            elif ctype == ColType.TIMESTAMP:
+                row[name] = int(v) + base_ms
+            elif arr.dtype == np.bool_:
+                row[name] = bool(v)
+            elif np.issubdtype(arr.dtype, np.integer):
+                row[name] = int(v)
+            else:
+                row[name] = float(v)
+        out.append(row)
+    return out
+
+
+def _dig(obj: dict, dotted: str):
+    cur = obj
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def _first_timestamp(row: dict, schema: Schema) -> Optional[int]:
+    for col in schema.columns:
+        if col.ctype == ColType.TIMESTAMP:
+            v = _dig(row, col.name)
+            if v is not None:
+                return int(v)
+    return None

@@ -1,0 +1,1 @@
+"""Shared utilities: data generation, gzip helpers, merging."""

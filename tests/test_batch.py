@@ -1,0 +1,160 @@
+"""Schema, Batch, and data generator tests."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from data_accelerator_tpu.core.batch import (
+    Batch,
+    batch_from_rows,
+    batch_to_rows,
+    empty_batch,
+)
+from data_accelerator_tpu.core.schema import (
+    ColType,
+    Schema,
+    StringDictionary,
+)
+from data_accelerator_tpu.utils.datagen import DataGenerator
+
+# the HomeAutomationLocal input schema (DeploymentLocal/sample/
+# HomeAutomationLocal.json gui.input.properties.inputSchemaFile)
+HA_SCHEMA_JSON = json.dumps(
+    {
+        "type": "struct",
+        "fields": [
+            {
+                "name": "deviceDetails",
+                "type": {
+                    "type": "struct",
+                    "fields": [
+                        {"name": "deviceId", "type": "long", "nullable": False,
+                         "metadata": {"allowedValues": [1, 2, 3, 4, 5, 6]}},
+                        {"name": "deviceType", "type": "string", "nullable": False,
+                         "metadata": {"allowedValues": ["DoorLock", "WindowLock", "Heating"]}},
+                        {"name": "eventTime", "type": "long", "nullable": False,
+                         "metadata": {"useCurrentTimeMillis": True}},
+                        {"name": "homeId", "type": "long", "nullable": False,
+                         "metadata": {"allowedValues": [32, 150, 25, 81]}},
+                        {"name": "status", "type": "long", "nullable": False,
+                         "metadata": {"allowedValues": [0, 1]}},
+                    ],
+                },
+                "nullable": False,
+                "metadata": {},
+            }
+        ],
+    }
+)
+
+
+def test_schema_flattens_nested_struct():
+    s = Schema.from_spark_json(HA_SCHEMA_JSON)
+    assert s.names == [
+        "deviceDetails.deviceId",
+        "deviceDetails.deviceType",
+        "deviceDetails.eventTime",
+        "deviceDetails.homeId",
+        "deviceDetails.status",
+    ]
+    assert s.column("deviceDetails.deviceType").ctype == ColType.STRING
+    assert s.column("deviceDetails.deviceId").ctype == ColType.LONG
+
+
+def test_string_dictionary_roundtrip():
+    d = StringDictionary()
+    a = d.encode("DoorLock")
+    b = d.encode("Heating")
+    assert d.encode("DoorLock") == a  # stable
+    assert d.decode(a) == "DoorLock"
+    assert d.decode(b) == "Heating"
+    assert d.lookup("nope") == -1
+    assert d.encode(None) == StringDictionary.NULL_ID
+    assert d.decode(StringDictionary.NULL_ID) is None
+
+
+def test_batch_from_rows_roundtrip():
+    s = Schema.from_spark_json(HA_SCHEMA_JSON)
+    d = StringDictionary()
+    rows = [
+        {"deviceDetails": {"deviceId": 3, "deviceType": "DoorLock",
+                           "eventTime": 1700000000000, "homeId": 150, "status": 1}},
+        {"deviceDetails": {"deviceId": 5, "deviceType": "Heating",
+                           "eventTime": 1700000000500, "homeId": 32, "status": 0}},
+    ]
+    b = batch_from_rows(rows, s, capacity=8, dictionary=d)
+    assert b.capacity == 8
+    assert int(b.count()) == 2
+    types = {c.name: c.ctype for c in s.columns}
+    out = batch_to_rows(b, d, types)
+    assert out[0]["deviceDetails.deviceType"] == "DoorLock"
+    assert out[1]["deviceDetails.homeId"] == 32
+    assert out[0]["deviceDetails.status"] == 1
+
+
+def test_timestamp_relative_encoding():
+    s = Schema.from_spark_json(json.dumps({
+        "type": "struct",
+        "fields": [{"name": "ts", "type": "timestamp", "nullable": False, "metadata": {}}],
+    }))
+    d = StringDictionary()
+    base = 1700000000000
+    rows = [{"ts": base}, {"ts": base + 2500}]
+    b = batch_from_rows(rows, s, capacity=4, dictionary=d)
+    np.testing.assert_array_equal(np.asarray(b.columns["ts"])[:2], [0, 2500])
+    out = batch_to_rows(b, d, {"ts": ColType.TIMESTAMP})
+    assert out[0]["ts"] == base
+    assert out[1]["ts"] == base + 2500
+
+
+def test_batch_is_pytree_and_jittable():
+    s = Schema.from_spark_json(HA_SCHEMA_JSON)
+    b = empty_batch(s, 16)
+
+    @jax.jit
+    def step(batch: Batch):
+        cols = dict(batch.columns)
+        cols["deviceDetails.status"] = cols["deviceDetails.status"] + 1
+        return batch.with_columns(cols)
+
+    out = step(b)
+    assert isinstance(out, Batch)
+    assert out.capacity == 16
+    np.testing.assert_array_equal(
+        np.asarray(out.columns["deviceDetails.status"]), np.ones(16, np.int32)
+    )
+
+
+def test_datagen_respects_metadata():
+    s = Schema.from_spark_json(HA_SCHEMA_JSON)
+    g = DataGenerator(s, seed=42)
+    rows = g.random_rows(50, now_ms=1700000000000)
+    for r in rows:
+        dd = r["deviceDetails"]
+        assert dd["deviceId"] in (1, 2, 3, 4, 5, 6)
+        assert dd["deviceType"] in ("DoorLock", "WindowLock", "Heating")
+        assert dd["homeId"] in (32, 150, 25, 81)
+        assert dd["status"] in (0, 1)
+        assert dd["eventTime"] == 1700000000000
+
+
+def test_datagen_vectorized_columns():
+    s = Schema.from_spark_json(HA_SCHEMA_JSON)
+    g = DataGenerator(s, seed=1)
+    d = StringDictionary()
+    cols = g.random_columns(1000, d, seed=7)
+    assert set(cols) == set(s.names)
+    ids = cols["deviceDetails.deviceType"]
+    decoded = set(d.decode_array(np.unique(ids)))
+    assert decoded <= {"DoorLock", "WindowLock", "Heating"}
+    assert cols["deviceDetails.homeId"].dtype == np.int32
+
+
+def test_schema_rejects_unsupported():
+    with pytest.raises(ValueError):
+        Schema.from_spark_json(json.dumps({
+            "type": "struct",
+            "fields": [{"name": "a", "type": {"type": "array", "elementType": "long"}}],
+        }))
