@@ -1,0 +1,140 @@
+"""SQL subset parser tests over the reference's real query shapes."""
+
+import pytest
+
+from data_accelerator_tpu.compile.sqlparser import (
+    BinOp,
+    Col,
+    Func,
+    InList,
+    Literal,
+    SqlParseError,
+    Star,
+    parse_select,
+)
+
+
+def test_simple_select():
+    s = parse_select("SELECT a, b AS bee FROM t WHERE a > 1")
+    assert [i.alias for i in s.items] == [None, "bee"]
+    assert s.from_table.name == "t"
+    assert isinstance(s.where, BinOp) and s.where.op == ">"
+
+
+def test_star_and_qualified_star():
+    s = parse_select("SELECT *, t.* FROM t")
+    assert isinstance(s.items[0].expr, Star)
+    assert s.items[1].expr.table == "t"
+
+
+def test_home_automation_query():
+    s = parse_select(
+        "SELECT deviceDetails.deviceId, deviceDetails.deviceType, eventTimeStamp, "
+        "deviceDetails.homeId, deviceDetails.status "
+        "FROM DataXProcessedInput_5minutes "
+        "GROUP BY deviceId, deviceType, eventTimeStamp, homeId, status"
+    )
+    assert s.items[0].expr == Col(("deviceDetails", "deviceId"))
+    assert len(s.group_by) == 5
+
+
+def test_join_with_on_and_alias():
+    s = parse_select(
+        "SELECT a.x, b.y FROM ta a INNER JOIN tb AS b ON a.k = b.k AND a.h = b.h "
+        "WHERE a.x = 1"
+    )
+    assert s.from_table.alias == "a"
+    assert s.joins[0].table.binding == "b"
+    assert s.joins[0].kind == "INNER"
+    assert isinstance(s.joins[0].on, BinOp) and s.joins[0].on.op == "AND"
+
+
+def test_aggregates_and_aliases():
+    s = parse_select(
+        "SELECT deviceId, MAX(eventTimeStamp) AS MaxEventTime, "
+        "MIN(status) AS MinReading, COUNT(*) AS Count, COUNT(DISTINCT EventTime) AS c2 "
+        "FROM DeviceWindowedInput GROUP BY deviceId"
+    )
+    f = s.items[1].expr
+    assert isinstance(f, Func) and f.name == "MAX"
+    cstar = s.items[3].expr
+    assert cstar.name == "COUNT" and isinstance(cstar.args[0], Star)
+    cd = s.items[4].expr
+    assert cd.distinct
+
+
+def test_backquoted_columns():
+    s = parse_select(
+        "SELECT 1 AS `doc.schemaversion`, 'alarm' AS `doc.schema`, "
+        "__ruleid AS `rule.id` FROM t"
+    )
+    assert s.items[0].alias == "doc.schemaversion"
+    assert s.items[2].expr == Col(("__ruleid",))
+
+
+def test_map_struct_functions():
+    s = parse_select(
+        "SELECT MAP('avg', AVG(temperature), 'max', MAX(temperature)) AS temperature, "
+        "STRUCT(__ruleid, __deviceid) AS agg FROM t GROUP BY __ruleid, __deviceid"
+    )
+    m = s.items[0].expr
+    assert m.name == "MAP" and len(m.args) == 4
+    assert m.args[0] == Literal("avg", "str")
+
+
+def test_nested_field_access_of_map_result():
+    s = parse_select("SELECT * FROM t WHERE temperature.avg > 0")
+    assert s.where.left == Col(("temperature", "avg"))
+
+
+def test_union_all_chain():
+    s = parse_select(
+        "SELECT a FROM t1 UNION ALL SELECT a FROM t2 UNION ALL SELECT a FROM t3"
+    )
+    assert s.union is not None and not s.union_distinct
+    assert s.union.union is not None
+
+
+def test_arithmetic_precedence():
+    s = parse_select("SELECT unix_timestamp()*1000 + 5 AS created FROM t")
+    e = s.items[0].expr
+    assert e.op == "+" and e.left.op == "*"
+    assert e.left.left == Func("UNIX_TIMESTAMP", ())
+
+
+def test_case_when_if_concat():
+    s = parse_select(
+        "SELECT IF(a > 1, 'big', 'small') AS size, "
+        "CONCAT('Door unlocked: ', deviceName, ' at home ', homeId) AS Pivot1, "
+        "CASE WHEN a = 1 THEN 'one' ELSE 'other' END AS c FROM t"
+    )
+    assert s.items[0].expr.name == "IF"
+    assert s.items[1].expr.name == "CONCAT"
+    assert s.items[2].expr.whens[0][1] == Literal("one", "str")
+
+
+def test_in_list_and_between_and_is_null():
+    s = parse_select(
+        "SELECT * FROM t WHERE a IN (1, 2, 3) AND b BETWEEN 1 AND 5 AND c IS NOT NULL"
+    )
+    w = s.where
+    assert isinstance(w.left.left, InList)
+
+
+def test_escaped_quote_in_string():
+    s = parse_select("SELECT 'it''s' AS x FROM t")
+    assert s.items[0].expr == Literal("it's", "str")
+
+
+def test_parse_error():
+    with pytest.raises(SqlParseError):
+        parse_select("SELECT FROM WHERE")
+
+
+def test_distinct_date_trunc():
+    s = parse_select(
+        "SELECT DISTINCT DATE_TRUNC('second', current_timestamp()) AS EventTime, "
+        "'CLOSEAlert' AS MetricName, 0 AS Metric FROM sa1_1_1"
+    )
+    assert s.distinct
+    assert s.items[0].expr.name == "DATE_TRUNC"
