@@ -1,0 +1,21 @@
+"""Mask compaction: gather valid rows to the front with a static size."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def compact_indices(
+    valid: jnp.ndarray, out_capacity: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Indices of valid rows packed to the front.
+
+    Returns (idx[out_capacity], out_valid[out_capacity]); gather columns
+    with ``col[idx]`` after masking by out_valid. Rows beyond
+    out_capacity drop (callers size capacity >= plausible counts).
+    """
+    (idx,) = jnp.nonzero(valid, size=out_capacity, fill_value=-1)
+    out_valid = idx >= 0
+    return jnp.where(out_valid, idx, 0), out_valid

@@ -1,0 +1,119 @@
+"""Sort-based GROUP BY for fixed-capacity masked batches.
+
+Replaces Spark's hash-exchange + aggregate for ``GROUP BY`` queries
+(reference: implicit in spark.sql, CommonProcessorFactory.scala:257) with
+an XLA-friendly static-shape pipeline:
+
+  1. lexsort rows by (invalid-last, key columns)
+  2. flag segment boundaries, prefix-sum into dense group ids
+  3. ``jax.ops.segment_*`` reductions into a capacity-sized output
+
+All shapes are static; invalid rows sort to the end and land in a dummy
+trailing segment that the output mask hides. Group count <= row count, so
+output capacity == input capacity is always sufficient.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _as_sortable(col: jnp.ndarray) -> jnp.ndarray:
+    """Make a column usable as a lexsort key (bool/float -> int bits)."""
+    if col.dtype == jnp.bool_:
+        return col.astype(jnp.int32)
+    if jnp.issubdtype(col.dtype, jnp.floating):
+        # total order on floats via sign-magnitude bit trick
+        bits = jax.lax.bitcast_convert_type(col.astype(jnp.float32), jnp.int32)
+        return jnp.where(bits < 0, jnp.int32(-2147483648) - bits, bits)
+    return col.astype(jnp.int32)
+
+
+def group_ids(
+    keys: Sequence[jnp.ndarray], valid: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Compute dense group ids for the masked rows.
+
+    Returns (order, gids_sorted, num_groups, first_in_group):
+    - order: [n] permutation sorting rows by (valid desc, keys)
+    - gids_sorted: [n] dense group id per *sorted* position; invalid rows
+      get id ``num_groups`` (a trailing dummy segment)
+    - num_groups: scalar count of real groups
+    - first_in_group: [n] bool, True at the first sorted row of each group
+    """
+    n = valid.shape[0]
+    sort_keys: List[jnp.ndarray] = [_as_sortable(k) for k in reversed(list(keys))]
+    # primary key: invalid rows last  (lexsort: last key is primary)
+    sort_keys.append(jnp.where(valid, 0, 1).astype(jnp.int32))
+    order = jnp.lexsort(sort_keys)
+
+    valid_s = valid[order]
+    boundary = jnp.zeros((n,), dtype=jnp.bool_)
+    for k in keys:
+        ks = k[order]
+        diff = jnp.concatenate([jnp.ones((1,), jnp.bool_), ks[1:] != ks[:-1]])
+        boundary = boundary | diff
+    if not list(keys):
+        boundary = boundary.at[0].set(True)
+    # only valid rows start groups; the first invalid row starts the dummy
+    first_invalid = jnp.concatenate(
+        [valid_s[:1] == False, valid_s[1:] != valid_s[:-1]]  # noqa: E712
+    )
+    boundary = (boundary & valid_s) | (first_invalid & ~valid_s)
+    # make sure position 0 is a boundary (group 0 or dummy)
+    boundary = boundary.at[0].set(True)
+
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1  # dense ids in sorted order
+    num_groups = jnp.sum((boundary & valid_s).astype(jnp.int32))
+    first_in_group = boundary & valid_s
+    return order, seg, num_groups, first_in_group
+
+
+def segment_aggregate(
+    values: jnp.ndarray,
+    seg: jnp.ndarray,
+    capacity: int,
+    op: str,
+    valid_s: jnp.ndarray,
+) -> jnp.ndarray:
+    """Aggregate sorted ``values`` per segment id into [capacity] output.
+
+    op: "sum" | "min" | "max" | "count" | "any" | "all"
+    Invalid rows must already carry the op's identity or sit in the dummy
+    trailing segment (>= capacity is dropped by segment_* ops: we clamp
+    ids of invalid rows to capacity).
+    """
+    num_segments = capacity + 1  # one extra dummy slot
+    seg = jnp.where(valid_s, seg, capacity)
+    if op == "count":
+        out = jax.ops.segment_sum(
+            jnp.ones_like(seg, dtype=jnp.int32), seg, num_segments=num_segments
+        )
+    elif op == "sum":
+        out = jax.ops.segment_sum(values, seg, num_segments=num_segments)
+    elif op == "min":
+        out = jax.ops.segment_min(values, seg, num_segments=num_segments)
+    elif op == "max":
+        out = jax.ops.segment_max(values, seg, num_segments=num_segments)
+    elif op == "any":
+        out = jax.ops.segment_max(values.astype(jnp.int32), seg, num_segments=num_segments).astype(jnp.bool_)
+    elif op == "all":
+        out = jax.ops.segment_min(values.astype(jnp.int32), seg, num_segments=num_segments).astype(jnp.bool_)
+    else:
+        raise ValueError(f"unknown aggregate op {op!r}")
+    return out[:capacity]
+
+
+def distinct_mask(keys: Sequence[jnp.ndarray], valid: jnp.ndarray) -> jnp.ndarray:
+    """Mask keeping one representative row per distinct key combination.
+
+    Used for SELECT DISTINCT: rows stay in place (no reordering); the
+    first occurrence in sort order survives.
+    """
+    order, _seg, _num, first = group_ids(keys, valid)
+    n = valid.shape[0]
+    keep = jnp.zeros((n,), dtype=jnp.bool_).at[order].set(first)
+    return keep & valid

@@ -1,0 +1,98 @@
+"""Capacity-bounded inner/left equi-join.
+
+Replaces Spark's shuffle-hash/broadcast join (implicit in spark.sql for
+the reference's JOIN queries, e.g. refdata joins in
+HomeAutomationLocal.json) with a static-shape pairwise-match formulation:
+build the [n, m] match matrix — an outer comparison the VPU chews through
+— then extract matching (left, right) index pairs with a fixed output
+capacity via ``jnp.nonzero(size=...)``.
+
+This favors the flows' actual join shapes (small-to-medium right sides:
+reference data, per-window aggregates). For large-x-large joins the
+``parallel`` layer shards the left side across devices so each chip holds
+an [n/d, m] tile.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax.numpy as jnp
+
+
+def inner_join_indices(
+    left_keys,
+    right_keys,
+    left_valid: jnp.ndarray,
+    right_valid: jnp.ndarray,
+    out_capacity: int,
+    residual: Optional[Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Return (left_idx[out], right_idx[out], valid[out]) of matching pairs.
+
+    left_keys/right_keys: sequences of [n] / [m] arrays (conjunctive
+    equality). ``residual``: optional extra predicate evaluated pairwise on
+    (left_row_idx_matrix, right_row_idx_matrix) -> [n, m] bool, for
+    non-equi ON terms.
+
+    Pairs beyond ``out_capacity`` are dropped (the planner sizes capacity
+    to the flow's configured bound and the runtime counts overflow as a
+    metric rather than failing, matching at-least-once streaming
+    semantics).
+    """
+    n = left_valid.shape[0]
+    m = right_valid.shape[0]
+    match = left_valid[:, None] & right_valid[None, :]
+    for lk, rk in zip(left_keys, right_keys):
+        match = match & (lk[:, None] == rk[None, :])
+    if residual is not None:
+        li = jnp.broadcast_to(jnp.arange(n)[:, None], (n, m))
+        ri = jnp.broadcast_to(jnp.arange(m)[None, :], (n, m))
+        match = match & residual(li, ri)
+
+    flat = match.reshape(-1)
+    (pair_idx,) = jnp.nonzero(flat, size=out_capacity, fill_value=-1)
+    valid = pair_idx >= 0
+    pair_idx = jnp.where(valid, pair_idx, 0)
+    left_idx = pair_idx // m
+    right_idx = pair_idx % m
+    return left_idx, right_idx, valid
+
+
+def left_join_indices(
+    left_keys,
+    right_keys,
+    left_valid: jnp.ndarray,
+    right_valid: jnp.ndarray,
+    out_capacity: int,
+    residual=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """LEFT OUTER variant: also emits unmatched left rows once.
+
+    Returns (left_idx, right_idx, valid, right_is_null): where
+    ``right_is_null`` marks rows whose right side carries no match (their
+    right columns must be nulled by the caller).
+    """
+    n = left_valid.shape[0]
+    m = right_valid.shape[0]
+    match = left_valid[:, None] & right_valid[None, :]
+    for lk, rk in zip(left_keys, right_keys):
+        match = match & (lk[:, None] == rk[None, :])
+    if residual is not None:
+        li = jnp.broadcast_to(jnp.arange(n)[:, None], (n, m))
+        ri = jnp.broadcast_to(jnp.arange(m)[None, :], (n, m))
+        match = match & residual(li, ri)
+
+    has_match = jnp.any(match, axis=1)
+    unmatched = left_valid & ~has_match
+    # matched pairs followed by unmatched-left singles, in one index space:
+    # pair space [n*m] then singles space [n]
+    flat = jnp.concatenate([match.reshape(-1), unmatched])
+    (idx,) = jnp.nonzero(flat, size=out_capacity, fill_value=-1)
+    valid = idx >= 0
+    idx = jnp.where(valid, idx, 0)
+    is_single = idx >= n * m
+    pair_idx = jnp.where(is_single, 0, idx)
+    left_idx = jnp.where(is_single, idx - n * m, pair_idx // m)
+    right_idx = jnp.where(is_single, 0, pair_idx % m)
+    return left_idx, right_idx, valid, is_single

@@ -1,0 +1,169 @@
+"""Numerical kernel tests vs numpy reference implementations — the layer
+the reference lacks entirely (SURVEY.md section 4 takeaway)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from data_accelerator_tpu.ops import (
+    compact_indices,
+    distinct_mask,
+    group_ids,
+    inner_join_indices,
+    segment_aggregate,
+)
+from data_accelerator_tpu.ops.join import left_join_indices
+
+
+def _np_groupby(keys, values, valid):
+    """Reference group-by using plain python."""
+    groups = {}
+    for i in range(len(valid)):
+        if not valid[i]:
+            continue
+        k = tuple(np.asarray(col)[i] for col in keys)
+        groups.setdefault(k, []).append(values[i])
+    return groups
+
+
+def test_group_ids_and_sum():
+    keys = [jnp.array([3, 1, 3, 2, 1, 9, 3, 0], dtype=jnp.int32)]
+    valid = jnp.array([1, 1, 1, 1, 1, 0, 1, 0], dtype=bool)
+    vals = jnp.array([10.0, 20, 30, 40, 50, 60, 70, 80], dtype=jnp.float32)
+
+    order, seg, num, first = group_ids(keys, valid)
+    assert int(num) == 3  # {1, 2, 3}
+    vals_s = vals[order]
+    valid_s = valid[order]
+    out = segment_aggregate(vals_s, seg, 8, "sum", valid_s)
+    # groups sorted by key: 1 -> 70, 2 -> 40, 3 -> 110
+    np.testing.assert_allclose(np.asarray(out[:3]), [70.0, 40.0, 110.0])
+
+
+def test_group_min_max_count():
+    k = jnp.array([1, 2, 1, 2, 1], dtype=jnp.int32)
+    valid = jnp.ones(5, dtype=bool)
+    v = jnp.array([5, 1, 3, 9, 4], dtype=jnp.int32)
+    order, seg, num, _ = group_ids([k], valid)
+    v_s, valid_s = v[order], valid[order]
+    assert int(num) == 2
+    np.testing.assert_array_equal(
+        np.asarray(segment_aggregate(v_s, seg, 5, "min", valid_s)[:2]), [3, 1]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(segment_aggregate(v_s, seg, 5, "max", valid_s)[:2]), [5, 9]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(segment_aggregate(v_s, seg, 5, "count", valid_s)[:2]), [3, 2]
+    )
+
+
+def test_group_by_multiple_keys_and_floats():
+    k1 = jnp.array([1, 1, 2, 2, 1], dtype=jnp.int32)
+    k2 = jnp.array([-1.5, -1.5, 0.5, 0.5, 2.5], dtype=jnp.float32)
+    valid = jnp.ones(5, dtype=bool)
+    order, seg, num, _ = group_ids([k1, k2], valid)
+    assert int(num) == 3
+
+
+def test_group_all_invalid():
+    k = jnp.array([1, 2], dtype=jnp.int32)
+    valid = jnp.zeros(2, dtype=bool)
+    _, _, num, first = group_ids([k], valid)
+    assert int(num) == 0
+    assert not np.asarray(first).any()
+
+
+def test_empty_keys_single_group():
+    # global aggregation: GROUP BY ()
+    valid = jnp.array([1, 1, 0, 1], dtype=bool)
+    v = jnp.array([1.0, 2, 99, 3], dtype=jnp.float32)
+    order, seg, num, _ = group_ids([], valid)
+    assert int(num) == 1
+    out = segment_aggregate(v[order], seg, 4, "sum", valid[order])
+    assert float(out[0]) == 6.0
+
+
+def test_distinct_mask():
+    k = jnp.array([7, 7, 8, 7, 8, 9], dtype=jnp.int32)
+    valid = jnp.array([1, 1, 1, 1, 1, 0], dtype=bool)
+    keep = distinct_mask([k], valid)
+    kept_keys = sorted(np.asarray(k)[np.asarray(keep)].tolist())
+    assert kept_keys == [7, 8]
+    assert int(np.asarray(keep).sum()) == 2
+
+
+def test_inner_join_basic():
+    lk = jnp.array([1, 2, 3, 4], dtype=jnp.int32)
+    rk = jnp.array([2, 3, 2], dtype=jnp.int32)
+    lv = jnp.ones(4, dtype=bool)
+    rv = jnp.array([1, 1, 1], dtype=bool)
+    li, ri, valid = inner_join_indices([lk], [rk], lv, rv, out_capacity=8)
+    pairs = {
+        (int(lk[li[i]]), int(rk[ri[i]]))
+        for i in range(8)
+        if bool(valid[i])
+    }
+    # key 2 matches right rows 0 and 2; key 3 matches right row 1
+    assert pairs == {(2, 2), (3, 3)}
+    assert int(np.asarray(valid).sum()) == 3  # (2,r0), (2,r2), (3,r1)
+
+
+def test_inner_join_residual_condition():
+    lk = jnp.array([1, 1], dtype=jnp.int32)
+    rk = jnp.array([1, 1], dtype=jnp.int32)
+    lval = jnp.array([10, 20], dtype=jnp.int32)
+    rval = jnp.array([15, 25], dtype=jnp.int32)
+    lv = jnp.ones(2, dtype=bool)
+    rv = jnp.ones(2, dtype=bool)
+    li, ri, valid = inner_join_indices(
+        [lk], [rk], lv, rv, 8,
+        residual=lambda i, j: lval[i] > rval[j],
+    )
+    got = {(int(li[i]), int(ri[i])) for i in range(8) if bool(valid[i])}
+    assert got == {(1, 0)}  # only 20 > 15
+
+
+def test_join_overflow_drops():
+    lk = jnp.zeros(4, dtype=jnp.int32)
+    rk = jnp.zeros(4, dtype=jnp.int32)
+    lv = jnp.ones(4, dtype=bool)
+    rv = jnp.ones(4, dtype=bool)
+    _, _, valid = inner_join_indices([lk], [rk], lv, rv, out_capacity=5)
+    assert int(np.asarray(valid).sum()) == 5  # 16 matches capped at 5
+
+
+def test_left_join_unmatched():
+    lk = jnp.array([1, 2], dtype=jnp.int32)
+    rk = jnp.array([2], dtype=jnp.int32)
+    lv = jnp.ones(2, dtype=bool)
+    rv = jnp.ones(1, dtype=bool)
+    li, ri, valid, is_null = left_join_indices([lk], [rk], lv, rv, 4)
+    rows = [
+        (int(lk[li[i]]), bool(is_null[i]))
+        for i in range(4)
+        if bool(valid[i])
+    ]
+    assert sorted(rows) == [(1, True), (2, False)]
+
+
+def test_compact():
+    valid = jnp.array([0, 1, 0, 1, 1], dtype=bool)
+    idx, out_valid = compact_indices(valid, 5)
+    assert np.asarray(idx)[:3].tolist() == [1, 3, 4]
+    assert np.asarray(out_valid).tolist() == [True, True, True, False, False]
+
+
+def test_ops_jit_compatible():
+    @jax.jit
+    def fn(k, valid, v):
+        order, seg, num, _ = group_ids([k], valid)
+        return segment_aggregate(v[order], seg, k.shape[0], "sum", valid[order]), num
+
+    out, num = fn(
+        jnp.array([1, 1, 2], dtype=jnp.int32),
+        jnp.ones(3, dtype=bool),
+        jnp.array([1.0, 2, 3], dtype=jnp.float32),
+    )
+    assert int(num) == 2
+    np.testing.assert_allclose(np.asarray(out[:2]), [3.0, 3.0])
