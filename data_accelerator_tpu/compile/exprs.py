@@ -1,0 +1,639 @@
+"""Typed expression compilation: SQL AST -> jax array programs.
+
+Every expression compiles to a ``CompiledExpr`` whose ``fn(env)`` returns
+a device array; ``env`` is an ``EvalEnv`` carrying the in-scope column
+arrays and the batch time context. Plan-level types extend the storage
+types with time encodings and composite values:
+
+- "long"/"double"/"boolean"/"string": as in core.schema (string = dict id)
+- "timestamp": int32 ms relative to the batch base (whole-second base)
+- "tssec":     int32 s  relative to the batch base (unix_timestamp math)
+- StructValue: named fields (MAP with literal keys / STRUCT)
+- ArrayValue:  fixed-length element list (Array/filterNull), elements may
+  carry validity (IF(cond, x, NULL))
+- HostStr:     deferred host-side string computation (CONCAT etc.) — the
+  device carries its input columns; the string materializes on the host
+  at sink/display time for the (few) surviving rows.
+
+Time design: the device never sees absolute epochs wider than int32.
+``base_s`` (int32 epoch seconds, whole-second) and ``now_rel_ms`` (int32)
+come in as traced scalars, so absolute-time functions (hour(),
+DATE_TRUNC) are exact integer math. reference analog: Spark SQL evaluates
+these on JVM longs; the contract (same results) is preserved, the
+representation is TPU-first.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+
+from ..core.config import EngineException
+from ..core.schema import StringDictionary
+from .sqlparser import (
+    BinOp,
+    CaseWhen,
+    Cast,
+    Col,
+    Expr,
+    Func,
+    InList,
+    IsNull,
+    Literal,
+    Star,
+    UnaryOp,
+)
+
+AGGREGATE_FNS = {"AVG", "MIN", "MAX", "SUM", "COUNT"}
+
+_DTYPES = {
+    "long": jnp.int32,
+    "double": jnp.float32,
+    "boolean": jnp.bool_,
+    "string": jnp.int32,
+    "timestamp": jnp.int32,
+    "tssec": jnp.int32,
+}
+
+
+@dataclass
+class EvalEnv:
+    """Columns in scope + time context, all device values."""
+
+    # binding -> {column dotted name -> array}
+    scopes: Dict[str, Dict[str, jnp.ndarray]]
+    base_s: jnp.ndarray  # scalar int32 epoch seconds (whole second)
+    now_rel_ms: jnp.ndarray  # scalar int32: "now" relative to base
+    shape: Tuple[int, ...] = ()  # row-shape for literal broadcasting
+
+    def column(self, binding: str, name: str) -> jnp.ndarray:
+        return self.scopes[binding][name]
+
+
+@dataclass
+class CompiledExpr:
+    type: str  # "long" | "double" | "boolean" | "string" | "timestamp" | "tssec"
+    fn: Callable[[EvalEnv], jnp.ndarray]
+    # source column dependencies (binding, column) — used for DISTINCT on
+    # deferred strings and for join-side analysis
+    deps: Tuple[Tuple[str, str], ...] = ()
+
+
+@dataclass
+class StructValue:
+    fields: Dict[str, "Value"]
+    validity: Optional[CompiledExpr] = None  # IF(cond, struct, NULL)
+
+
+@dataclass
+class ArrayValue:
+    elements: List["Value"]
+
+
+@dataclass
+class HostStr:
+    """Deferred string expression: parts are literal strs or CompiledExpr
+    whose device value gets decoded/stringified on the host at sink time."""
+
+    parts: List[Union[str, CompiledExpr]]
+    deps: Tuple[Tuple[str, str], ...] = ()
+
+
+Value = Union[CompiledExpr, StructValue, ArrayValue, HostStr]
+
+
+def is_device(v: Value) -> bool:
+    return isinstance(v, CompiledExpr)
+
+
+# ---------------------------------------------------------------------------
+# Name resolution
+# ---------------------------------------------------------------------------
+@dataclass
+class Scope:
+    """Resolution scope: bindings (table aliases) -> column name -> type.
+
+    Column values may be plan types (str) or composite Values for columns
+    that are themselves deferred (HostStr passthrough).
+    """
+
+    tables: Dict[str, Dict[str, str]]  # binding -> {col -> type}
+    deferred: Dict[str, Dict[str, HostStr]] = field(default_factory=dict)
+
+    def resolve(self, parts: Sequence[str]) -> Tuple[str, str]:
+        """Resolve a dotted reference to (binding, column_name).
+
+        Rules (covering the reference flows' usage):
+        1. if parts[0] is a binding, resolve the remainder inside it;
+        2. otherwise search all bindings for an exact dotted match, then a
+           unique dot-boundary suffix match (``deviceId`` matches
+           ``deviceDetails.deviceId``).
+        """
+        dotted = ".".join(parts)
+        if parts[0] in self.tables and len(parts) > 1:
+            binding = parts[0]
+            rest = ".".join(parts[1:])
+            col = self._match_in(binding, rest)
+            if col is not None:
+                return binding, col
+            # fall through: maybe "deviceDetails.deviceId" where
+            # deviceDetails coincides with nothing
+        candidates: List[Tuple[str, str]] = []
+        for binding in self.tables:
+            col = self._match_in(binding, dotted)
+            if col is not None:
+                candidates.append((binding, col))
+        if len(candidates) == 1:
+            return candidates[0]
+        if len(candidates) > 1:
+            # a join scope's merged "" binding subsumes the per-table
+            # bindings (it exists exactly so unqualified names resolve
+            # once); prefer it
+            merged = [c for c in candidates if c[0] == ""]
+            if len(merged) == 1:
+                return merged[0]
+            # then prefer exact-name matches over suffix matches
+            exact = [c for c in candidates if c[1] == dotted]
+            if len(exact) == 1:
+                return exact[0]
+            raise EngineException(
+                f"ambiguous column reference '{dotted}' across {sorted(t for t, _ in candidates)}"
+            )
+        raise EngineException(
+            f"cannot resolve column '{dotted}' in scope "
+            f"{ {b: sorted(cols) for b, cols in self.tables.items()} }"
+        )
+
+    def _match_in(self, binding: str, dotted: str) -> Optional[str]:
+        cols = self.tables[binding]
+        if dotted in cols:
+            return dotted
+        suffix_matches = [c for c in cols if c.endswith("." + dotted)]
+        if len(suffix_matches) == 1:
+            return suffix_matches[0]
+        if len(suffix_matches) > 1:
+            raise EngineException(
+                f"ambiguous column suffix '{dotted}' in table '{binding}': {suffix_matches}"
+            )
+        return None
+
+    def type_of(self, binding: str, col: str) -> str:
+        return self.tables[binding][col]
+
+
+# ---------------------------------------------------------------------------
+# Numeric promotion helpers
+# ---------------------------------------------------------------------------
+def _promote(a: str, b: str) -> str:
+    if a == b:
+        return a
+    numeric_rank = {"boolean": 0, "long": 1, "tssec": 1, "timestamp": 1, "double": 2}
+    if a in numeric_rank and b in numeric_rank:
+        return "double" if numeric_rank[a] == 2 or numeric_rank[b] == 2 else "long"
+    raise EngineException(f"cannot combine types {a} and {b}")
+
+
+def _to_dtype(arr: jnp.ndarray, t: str) -> jnp.ndarray:
+    return arr.astype(_DTYPES[t])
+
+
+# ---------------------------------------------------------------------------
+# Expression compiler
+# ---------------------------------------------------------------------------
+class ExprCompiler:
+    """Compile AST expressions against a Scope.
+
+    ``udfs``: name -> callable(device arrays...) -> (array, type) for the
+    jax UDF tier; host UDFs (str -> str) come through the registry and
+    produce HostStr values.
+    """
+
+    def __init__(
+        self,
+        scope: Scope,
+        dictionary: StringDictionary,
+        udfs: Optional[dict] = None,
+    ):
+        self.scope = scope
+        self.dictionary = dictionary
+        self.udfs = udfs or {}
+
+    # -- public ----------------------------------------------------------
+    def compile(self, e: Expr) -> Value:
+        if isinstance(e, Literal):
+            return self._literal(e)
+        if isinstance(e, Col):
+            return self._column(e)
+        if isinstance(e, BinOp):
+            return self._binop(e)
+        if isinstance(e, UnaryOp):
+            return self._unary(e)
+        if isinstance(e, Func):
+            return self._func(e)
+        if isinstance(e, Cast):
+            return self._cast(e)
+        if isinstance(e, InList):
+            return self._in_list(e)
+        if isinstance(e, CaseWhen):
+            return self._case(e)
+        if isinstance(e, IsNull):
+            return self._is_null(e)
+        if isinstance(e, Star):
+            raise EngineException("* only allowed as a top-level select item")
+        raise EngineException(f"unsupported expression {e!r}")
+
+    def compile_device(self, e: Expr, what: str = "expression") -> CompiledExpr:
+        v = self.compile(e)
+        if not is_device(v):
+            raise EngineException(
+                f"{what} must be device-computable, got deferred/composite: {e!r}"
+            )
+        return v
+
+    # -- leaves ----------------------------------------------------------
+    def _literal(self, e: Literal) -> Value:
+        if e.kind == "str":
+            sid = self.dictionary.encode(e.value)
+            return CompiledExpr(
+                "string",
+                lambda env, sid=sid: jnp.broadcast_to(
+                    jnp.asarray(sid, jnp.int32), env.shape
+                ),
+            )
+        if e.kind == "null":
+            # bare NULL only appears inside IF(cond, x, NULL); handled there
+            return CompiledExpr(
+                "long", lambda env: jnp.broadcast_to(jnp.asarray(0, jnp.int32), env.shape)
+            )
+        if e.kind == "bool":
+            return CompiledExpr(
+                "boolean",
+                lambda env, v=e.value: jnp.broadcast_to(jnp.asarray(v), env.shape),
+            )
+        if e.kind == "float":
+            return CompiledExpr(
+                "double",
+                lambda env, v=e.value: jnp.broadcast_to(
+                    jnp.asarray(v, jnp.float32), env.shape
+                ),
+            )
+        return CompiledExpr(
+            "long",
+            lambda env, v=e.value: jnp.broadcast_to(jnp.asarray(v, jnp.int32), env.shape),
+        )
+
+    def _column(self, e: Col) -> Value:
+        binding, col = self.scope.resolve(e.parts)
+        deferred = self.scope.deferred.get(binding, {})
+        if col in deferred:
+            h = deferred[col]
+            return HostStr(list(h.parts), h.deps)
+        t = self.scope.type_of(binding, col)
+        return CompiledExpr(
+            t,
+            lambda env, b=binding, c=col: env.column(b, c),
+            deps=((binding, col),),
+        )
+
+    # -- operators -------------------------------------------------------
+    def _binop(self, e: BinOp) -> Value:
+        op = e.op
+        if op in ("AND", "OR"):
+            l = self.compile_device(e.left, "boolean operand")
+            r = self.compile_device(e.right, "boolean operand")
+            f = jnp.logical_and if op == "AND" else jnp.logical_or
+            return CompiledExpr(
+                "boolean",
+                lambda env, l=l, r=r, f=f: f(l.fn(env), r.fn(env)),
+                deps=l.deps + r.deps,
+            )
+
+        l = self._as_device(e.left)
+        r = self._as_device(e.right)
+
+        if op in ("=", "!=", "<", "<=", ">", ">="):
+            return self._comparison(op, l, r)
+        return self._arith(op, l, r)
+
+    def _as_device(self, e: Expr) -> CompiledExpr:
+        v = self.compile(e)
+        if isinstance(v, HostStr):
+            raise EngineException(
+                "deferred string expressions (CONCAT/CAST-to-string results) "
+                f"cannot be used in device computation: {e!r}"
+            )
+        if not is_device(v):
+            raise EngineException(f"composite value not usable here: {e!r}")
+        return v
+
+    def _comparison(self, op: str, l: CompiledExpr, r: CompiledExpr) -> CompiledExpr:
+        lt, rt = l.type, r.type
+        if ("string" in (lt, rt)) and lt != rt:
+            raise EngineException(f"cannot compare {lt} with {rt}")
+        if lt == "string" and op not in ("=", "!="):
+            raise EngineException("string ordering comparisons are not supported")
+        # timestamp/tssec comparisons: both sides share the batch base, so
+        # relative values compare exactly
+        cast = None
+        if lt != rt and "string" not in (lt, rt):
+            cast = _promote(lt, rt)
+
+        import operator as _op
+
+        fns = {
+            "=": _op.eq, "!=": _op.ne, "<": _op.lt,
+            "<=": _op.le, ">": _op.gt, ">=": _op.ge,
+        }
+        f = fns[op]
+
+        def run(env, l=l, r=r, f=f, cast=cast):
+            a, b = l.fn(env), r.fn(env)
+            if cast is not None:
+                a, b = _to_dtype(a, cast), _to_dtype(b, cast)
+            return f(a, b)
+
+        return CompiledExpr("boolean", run, deps=l.deps + r.deps)
+
+    def _arith(self, op: str, l: CompiledExpr, r: CompiledExpr) -> CompiledExpr:
+        lt, rt = l.type, r.type
+        if "string" in (lt, rt):
+            raise EngineException("arithmetic on strings is not supported")
+
+        # time-typed special cases (see module docstring)
+        if op == "*" and lt == "tssec" and rt == "long":
+            # unix_timestamp()*1000 -> absolute epoch ms; keep it relative
+            def run_ms(env, l=l, r=r):
+                return l.fn(env).astype(jnp.int32) * 1000
+            return CompiledExpr("timestamp", run_ms, deps=l.deps + r.deps)
+        if op == "-" and lt in ("timestamp", "tssec") and rt == lt:
+            out_t = "long"
+
+            def run_diff(env, l=l, r=r):
+                return l.fn(env).astype(jnp.int32) - r.fn(env).astype(jnp.int32)
+
+            return CompiledExpr(out_t, run_diff, deps=l.deps + r.deps)
+        if lt in ("timestamp", "tssec") and rt == "long" and op in ("+", "-"):
+            def run_shift(env, l=l, r=r, neg=(op == "-")):
+                b = r.fn(env).astype(jnp.int32)
+                return l.fn(env) + (-b if neg else b)
+            return CompiledExpr(lt, run_shift, deps=l.deps + r.deps)
+
+        out_t = _promote(lt, rt)
+        if op == "/":
+            out_t = "double"
+
+        import operator as _op
+
+        fns = {"+": _op.add, "-": _op.sub, "*": _op.mul, "%": _op.mod}
+
+        def run(env, l=l, r=r, op=op, out_t=out_t):
+            a, b = _to_dtype(l.fn(env), out_t), _to_dtype(r.fn(env), out_t)
+            if op == "/":
+                return a / b
+            return fns[op](a, b)
+
+        return CompiledExpr(out_t, run, deps=l.deps + r.deps)
+
+    def _unary(self, e: UnaryOp) -> Value:
+        v = self._as_device(e.operand)
+        if e.op == "NOT":
+            return CompiledExpr(
+                "boolean", lambda env, v=v: jnp.logical_not(v.fn(env)), deps=v.deps
+            )
+        return CompiledExpr(v.type, lambda env, v=v: -v.fn(env), deps=v.deps)
+
+    def _in_list(self, e: InList) -> Value:
+        v = self._as_device(e.expr)
+        opts = [self._as_device(o) for o in e.options]
+
+        def run(env, v=v, opts=opts, neg=e.negated):
+            a = v.fn(env)
+            m = jnp.zeros_like(a, dtype=jnp.bool_)
+            for o in opts:
+                m = m | (a == o.fn(env).astype(a.dtype))
+            return jnp.logical_not(m) if neg else m
+
+        deps = v.deps + tuple(d for o in opts for d in o.deps)
+        return CompiledExpr("boolean", run, deps=deps)
+
+    def _case(self, e: CaseWhen) -> Value:
+        whens = [
+            (self._as_device(c), self._as_device(x)) for c, x in e.whens
+        ]
+        otherwise = self._as_device(e.otherwise) if e.otherwise else None
+        out_t = whens[0][1].type
+        for _, x in whens[1:]:
+            out_t = _promote(out_t, x.type)
+        if otherwise is not None:
+            out_t = _promote(out_t, otherwise.type)
+
+        def run(env, whens=whens, otherwise=otherwise, out_t=out_t):
+            if otherwise is not None:
+                acc = _to_dtype(otherwise.fn(env), out_t)
+            else:
+                acc = jnp.zeros(env.shape, dtype=_DTYPES[out_t])
+            for cond, val in reversed(whens):
+                acc = jnp.where(cond.fn(env), _to_dtype(val.fn(env), out_t), acc)
+            return acc
+
+        deps = tuple(
+            d for c, x in whens for d in c.deps + x.deps
+        ) + (otherwise.deps if otherwise else ())
+        return CompiledExpr(out_t, run, deps=deps)
+
+    def _is_null(self, e: IsNull) -> Value:
+        # row-validity handles nulls; a present device value is non-null
+        val = bool(e.negated)
+        return CompiledExpr(
+            "boolean", lambda env, v=val: jnp.broadcast_to(jnp.asarray(v), env.shape)
+        )
+
+    def _cast(self, e: Cast) -> Value:
+        target = e.target
+        if target in ("STRING", "VARCHAR"):
+            inner = self._as_device(e.expr)
+            if inner.type == "string":
+                return inner
+            # stringification is a host-side finishing step
+            return HostStr(parts=["", inner], deps=inner.deps)
+        inner = self._as_device(e.expr)
+        t = {
+            "LONG": "long", "INT": "long", "INTEGER": "long", "BIGINT": "long",
+            "DOUBLE": "double", "FLOAT": "double", "BOOLEAN": "boolean",
+            "TIMESTAMP": "timestamp",
+        }.get(target)
+        if t is None:
+            raise EngineException(f"unsupported CAST target {target}")
+        return CompiledExpr(
+            t, lambda env, inner=inner, t=t: _to_dtype(inner.fn(env), t), deps=inner.deps
+        )
+
+    # -- functions -------------------------------------------------------
+    def _func(self, e: Func) -> Value:
+        name = e.name
+
+        if name in AGGREGATE_FNS:
+            raise EngineException(
+                f"aggregate {name} outside aggregation context"
+            )
+
+        if name == "IF":
+            if len(e.args) != 3:
+                raise EngineException("IF takes 3 arguments")
+            cond = self._as_device(e.args[0])
+            then_v = self.compile(e.args[1])
+            else_v = self.compile(e.args[2])
+            # IF(cond, <struct/map>, NULL): nullable struct
+            if isinstance(then_v, StructValue) and isinstance(e.args[2], Literal) \
+                    and e.args[2].kind == "null":
+                return StructValue(then_v.fields, validity=cond)
+            if not is_device(then_v) or not is_device(else_v):
+                raise EngineException("IF branches must be device values")
+            out_t = _promote(then_v.type, else_v.type) if then_v.type != else_v.type \
+                else then_v.type
+
+            def run(env, cond=cond, a=then_v, b=else_v, out_t=out_t):
+                return jnp.where(
+                    cond.fn(env), _to_dtype(a.fn(env), out_t), _to_dtype(b.fn(env), out_t)
+                )
+
+            return CompiledExpr(
+                out_t, run, deps=cond.deps + then_v.deps + else_v.deps
+            )
+
+        if name == "COALESCE":
+            args = [self._as_device(a) for a in e.args]
+            return args[0]  # no value-level nulls on device
+
+        if name in ("MAP",):
+            # MAP('k1', v1, 'k2', v2, ...) with literal keys == struct
+            if len(e.args) % 2 != 0:
+                raise EngineException("MAP needs key/value pairs")
+            fields: Dict[str, Value] = {}
+            for i in range(0, len(e.args), 2):
+                k = e.args[i]
+                if not (isinstance(k, Literal) and k.kind == "str"):
+                    raise EngineException("MAP keys must be string literals")
+                fields[k.value] = self.compile(e.args[i + 1])
+            return StructValue(fields)
+
+        if name == "STRUCT":
+            fields = {}
+            for a in e.args:
+                if isinstance(a, Col):
+                    fields[a.parts[-1]] = self.compile(a)
+                else:
+                    raise EngineException(
+                        "STRUCT arguments must be columns (use MAP for expressions)"
+                    )
+            return StructValue(fields)
+
+        if name == "ARRAY":
+            return ArrayValue([self.compile(a) for a in e.args])
+
+        if name == "FILTERNULL":
+            inner = self.compile(e.args[0])
+            if not isinstance(inner, ArrayValue):
+                raise EngineException("filterNull expects an Array")
+            return inner
+
+        if name == "CONCAT":
+            parts: List[Union[str, CompiledExpr]] = []
+            deps: Tuple[Tuple[str, str], ...] = ()
+            for a in e.args:
+                v = self.compile(a)
+                if isinstance(v, HostStr):
+                    parts.extend(v.parts)
+                    deps += v.deps
+                elif isinstance(v, CompiledExpr):
+                    if isinstance(a, Literal) and a.kind == "str":
+                        parts.append(a.value)
+                    else:
+                        parts.append(v)
+                        deps += v.deps
+                else:
+                    raise EngineException("CONCAT of composite values unsupported")
+            return HostStr(parts, deps)
+
+        if name == "CURRENT_TIMESTAMP":
+            return CompiledExpr(
+                "timestamp",
+                lambda env: jnp.broadcast_to(env.now_rel_ms, env.shape),
+            )
+        if name == "UNIX_TIMESTAMP":
+            if e.args:
+                ts = self._as_device(e.args[0])
+                return CompiledExpr(
+                    "tssec",
+                    lambda env, ts=ts: ts.fn(env) // 1000,
+                    deps=ts.deps,
+                )
+            return CompiledExpr(
+                "tssec",
+                lambda env: jnp.broadcast_to(env.now_rel_ms // 1000, env.shape),
+            )
+        if name == "TO_UNIX_TIMESTAMP":
+            ts = self._as_device(e.args[0])
+            if ts.type not in ("timestamp", "tssec"):
+                raise EngineException("to_unix_timestamp expects a timestamp")
+            if ts.type == "tssec":
+                return ts
+            return CompiledExpr(
+                "tssec", lambda env, ts=ts: ts.fn(env) // 1000, deps=ts.deps
+            )
+        if name == "DATE_TRUNC":
+            unit_lit = e.args[0]
+            if not isinstance(unit_lit, Literal):
+                raise EngineException("DATE_TRUNC unit must be a literal")
+            unit = str(unit_lit.value).lower()
+            ts = self._as_device(e.args[1])
+            secs = {"second": 1, "minute": 60, "hour": 3600, "day": 86400}.get(unit)
+            if secs is None:
+                raise EngineException(f"unsupported DATE_TRUNC unit {unit}")
+
+            def run(env, ts=ts, secs=secs):
+                rel = ts.fn(env)
+                total_s = env.base_s + rel // 1000
+                trunc_s = total_s - total_s % secs
+                return ((trunc_s - env.base_s) * 1000).astype(jnp.int32)
+
+            return CompiledExpr("timestamp", run, deps=ts.deps)
+        if name in ("HOUR", "MINUTE", "SECOND"):
+            ts = self._as_device(e.args[0])
+            div = {"HOUR": 3600, "MINUTE": 60, "SECOND": 1}[name]
+            mod = {"HOUR": 24, "MINUTE": 60, "SECOND": 60}[name]
+
+            def run(env, ts=ts, div=div, mod=mod):
+                rel = ts.fn(env)
+                total_s = env.base_s + rel // 1000
+                return ((total_s // div) % mod).astype(jnp.int32)
+
+            return CompiledExpr("long", run, deps=ts.deps)
+
+        if name in ("ABS", "FLOOR", "CEIL", "ROUND", "SQRT", "EXP", "LOG"):
+            v = self._as_device(e.args[0])
+            jf = {
+                "ABS": jnp.abs, "FLOOR": jnp.floor, "CEIL": jnp.ceil,
+                "ROUND": jnp.round, "SQRT": jnp.sqrt, "EXP": jnp.exp,
+                "LOG": jnp.log,
+            }[name]
+            out_t = v.type if name == "ABS" else (
+                "double" if name in ("SQRT", "EXP", "LOG") else v.type
+            )
+
+            def run(env, v=v, jf=jf, out_t=out_t):
+                x = v.fn(env)
+                if jf in (jnp.floor, jnp.ceil, jnp.round, jnp.sqrt, jnp.exp, jnp.log):
+                    x = x.astype(jnp.float32)
+                return _to_dtype(jf(x), out_t)
+
+            return CompiledExpr(out_t, run, deps=v.deps)
+
+        # UDF tiers
+        lowered = name.lower()
+        if lowered in self.udfs:
+            return self.udfs[lowered].compile_call(self, e)
+
+        raise EngineException(f"unknown function {name}")

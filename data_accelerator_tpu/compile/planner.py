@@ -1,0 +1,799 @@
+"""Select/pipeline planner: lower parsed SQL onto the ops layer.
+
+The compiled artifact is a pure function over columnar tables — the
+whole transform pipeline (all ``--DataXQuery--`` statements of a flow)
+composes into one traced program the runtime jits once and reuses every
+micro-batch. This replaces the reference's per-batch ``spark.sql``
+planning/execution (CommonProcessorFactory.scala:249-293).
+
+Tables flow through as ``TableData`` (columns dict + validity mask);
+capacities are static and derived per statement (input capacity for
+project/filter/group-by, configured bound for joins, sum for unions).
+
+Deferred string columns (CONCAT results etc.) materialize their device
+inputs as hidden ``__defer.`` columns so they ride along through
+downstream selects and become strings only on the host at sink time.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field, replace
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+
+from ..core.config import EngineException
+from ..core.schema import StringDictionary
+from ..ops import (
+    compact_indices,
+    distinct_mask,
+    group_ids,
+    inner_join_indices,
+    segment_aggregate,
+)
+from ..ops.join import left_join_indices
+from .exprs import (
+    AGGREGATE_FNS,
+    ArrayValue,
+    CompiledExpr,
+    EvalEnv,
+    ExprCompiler,
+    HostStr,
+    Scope,
+    StructValue,
+    Value,
+    is_device,
+)
+from .sqlparser import (
+    BinOp,
+    Col,
+    Expr,
+    Func,
+    Select,
+    SelectItem,
+    Star,
+    parse_select,
+)
+
+# ---------------------------------------------------------------------------
+# Schemas and table data
+# ---------------------------------------------------------------------------
+DeferredPart = Union[str, Tuple[str, str]]  # literal | (hidden_col, type)
+
+
+@dataclass(frozen=True)
+class ViewSchema:
+    """Device column types + deferred host-string column templates."""
+
+    types: Dict[str, str]
+    deferred: Dict[str, Tuple[DeferredPart, ...]] = field(default_factory=dict)
+
+    def all_names(self) -> List[str]:
+        """User-visible column names (device + deferred, no hidden)."""
+        return [c for c in self.types if not c.startswith("__defer.")] + list(
+            self.deferred
+        )
+
+
+import jax
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class TableData:
+    cols: Dict[str, jnp.ndarray]
+    valid: jnp.ndarray
+
+    @property
+    def capacity(self) -> int:
+        return int(self.valid.shape[0])
+
+    def tree_flatten(self):
+        names = tuple(sorted(self.cols))
+        return tuple(self.cols[n] for n in names) + (self.valid,), names
+
+    @classmethod
+    def tree_unflatten(cls, names, children):
+        return cls(dict(zip(names, children[:-1])), children[-1])
+
+
+@dataclass
+class CompiledView:
+    name: str
+    schema: ViewSchema
+    capacity: int
+    # fn(tables: {name: TableData}, base_s, now_rel_ms) -> TableData
+    fn: Callable[[Dict[str, TableData], jnp.ndarray, jnp.ndarray], TableData]
+
+
+# ---------------------------------------------------------------------------
+# Aggregate-aware expression compiler
+# ---------------------------------------------------------------------------
+class _AggCollector(ExprCompiler):
+    """ExprCompiler that records aggregate calls and compiles them into
+    placeholder reads from the "__agg" scope."""
+
+    def __init__(self, scope, dictionary, udfs):
+        super().__init__(scope, dictionary, udfs)
+        self.agg_nodes: Dict[str, Tuple[str, Optional[Expr], bool]] = {}
+        self._counter = itertools.count()
+
+    def _func(self, e: Func):
+        if e.name in AGGREGATE_FNS:
+            key = f"agg{next(self._counter)}"
+            arg = None if (not e.args or isinstance(e.args[0], Star)) else e.args[0]
+            self.agg_nodes[key] = (e.name, arg, e.distinct)
+            out_t = self._agg_type(e.name, arg)
+            return CompiledExpr(
+                out_t, lambda env, key=key: env.scopes["__agg"][key]
+            )
+        return super()._func(e)
+
+    def _agg_type(self, name: str, arg: Optional[Expr]) -> str:
+        if name == "COUNT":
+            return "long"
+        if arg is None:
+            raise EngineException(f"{name} requires an argument")
+        inner = ExprCompiler(self.scope, self.dictionary, self.udfs).compile(arg)
+        if not is_device(inner):
+            raise EngineException(f"cannot aggregate non-device expression {arg!r}")
+        if name == "AVG":
+            return "double"
+        if name == "SUM":
+            return "double" if inner.type == "double" else "long"
+        return inner.type  # MIN/MAX preserve
+
+
+def _has_aggregate(e: Expr) -> bool:
+    if isinstance(e, Func):
+        if e.name in AGGREGATE_FNS:
+            return True
+        return any(_has_aggregate(a) for a in e.args if not isinstance(a, Star))
+    for attr in ("left", "right", "operand", "expr"):
+        sub = getattr(e, attr, None)
+        if sub is not None and not isinstance(sub, (str, tuple)) and _has_aggregate(sub):
+            return True
+    if hasattr(e, "whens"):
+        for c, v in e.whens:
+            if _has_aggregate(c) or _has_aggregate(v):
+                return True
+        if e.otherwise is not None and _has_aggregate(e.otherwise):
+            return True
+    if hasattr(e, "options"):
+        return any(_has_aggregate(o) for o in e.options)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Planner config
+# ---------------------------------------------------------------------------
+@dataclass(frozen=True)
+class PlannerConfig:
+    join_capacity_factor: float = 1.0  # out_cap = factor * max(left, right)
+    min_join_capacity: int = 64
+    # grouped outputs are compacted to the front, so their capacity can be
+    # bounded below the input capacity — this is what keeps downstream
+    # shapes small when grouping huge windowed tables (groups beyond the
+    # bound drop; the runtime surfaces overflow as a metric)
+    max_group_capacity: int = 4096
+
+
+# ---------------------------------------------------------------------------
+# Select compiler
+# ---------------------------------------------------------------------------
+class SelectCompiler:
+    def __init__(
+        self,
+        catalog: Dict[str, ViewSchema],
+        capacities: Dict[str, int],
+        dictionary: StringDictionary,
+        udfs: Optional[dict] = None,
+        config: PlannerConfig = PlannerConfig(),
+    ):
+        self.catalog = catalog
+        self.capacities = capacities
+        self.dictionary = dictionary
+        self.udfs = udfs or {}
+        self.config = config
+
+    # -- entry -----------------------------------------------------------
+    def compile_select(self, name: str, sel: Select) -> CompiledView:
+        if sel.union is not None:
+            return self._compile_union(name, sel)
+        return self._compile_single(name, sel)
+
+    # -- union -----------------------------------------------------------
+    def _compile_union(self, name: str, sel: Select) -> CompiledView:
+        branches: List[Select] = []
+        cur: Optional[Select] = sel
+        while cur is not None:
+            branches.append(replace(cur, union=None, union_distinct=False))
+            cur = cur.union
+        compiled = [self._compile_single(f"{name}${i}", b) for i, b in enumerate(branches)]
+        first = compiled[0]
+        names0 = list(first.schema.types) + list(first.schema.deferred)
+        for c in compiled[1:]:
+            if len(list(c.schema.types)) != len(list(first.schema.types)):
+                raise EngineException(
+                    f"UNION branches of {name} have different column counts"
+                )
+        capacity = sum(c.capacity for c in compiled)
+        # align by position onto the first branch's names
+        maps = []
+        for c in compiled:
+            maps.append(dict(zip(c.schema.types, first.schema.types)))
+
+        def run(tables, base_s, now_rel_ms, compiled=compiled, maps=maps):
+            outs = [c.fn(tables, base_s, now_rel_ms) for c in compiled]
+            cols = {}
+            for target in first.schema.types:
+                parts = []
+                for out, m in zip(outs, maps):
+                    src = [k for k, v in m.items() if v == target]
+                    parts.append(out.cols[src[0]])
+                cols[target] = jnp.concatenate(parts)
+            valid = jnp.concatenate([o.valid for o in outs])
+            return TableData(cols, valid)
+
+        schema = ViewSchema(dict(first.schema.types), dict(first.schema.deferred))
+        return CompiledView(name, schema, capacity, run)
+
+    # -- single select ---------------------------------------------------
+    def _compile_single(self, name: str, sel: Select) -> CompiledView:
+        if sel.from_table is None:
+            raise EngineException(f"SELECT without FROM not supported ({name})")
+
+        # 1. FROM/JOIN scope
+        scope, build_scope, scope_capacity = self._compile_from(sel)
+
+        compiler = _AggCollector(scope, self.dictionary, self.udfs)
+
+        # 2. WHERE
+        where_fn = None
+        if sel.where is not None:
+            where_c = ExprCompiler(scope, self.dictionary, self.udfs).compile(sel.where)
+            if not is_device(where_c):
+                raise EngineException("WHERE must be device-computable")
+            where_fn = where_c.fn
+
+        grouped = bool(sel.group_by) or any(
+            _has_aggregate(i.expr) for i in sel.items if not isinstance(i.expr, Star)
+        )
+
+        # 3. select items -> named output values
+        out_values: List[Tuple[str, Value]] = []
+        for item in sel.items:
+            out_values.extend(self._expand_item(item, scope, compiler))
+
+        out_types, deferred, flat_outputs = self._flatten_outputs(out_values)
+
+        if grouped:
+            return self._compile_grouped(
+                name, sel, scope, compiler, build_scope, scope_capacity,
+                where_fn, out_types, deferred, flat_outputs, out_values,
+            )
+
+        # 4. plain projection/filter
+        distinct_keys = None
+        if sel.distinct:
+            distinct_keys = self._distinct_key_exprs(out_values)
+
+        def run(tables, base_s, now_rel_ms):
+            scopes, valid, shape = build_scope(tables, base_s, now_rel_ms)
+            env = EvalEnv(scopes, base_s, now_rel_ms, shape)
+            if where_fn is not None:
+                valid = valid & where_fn(env)
+            cols = {n: fn(env) for n, fn in flat_outputs}
+            if distinct_keys is not None:
+                env2 = EvalEnv(scopes, base_s, now_rel_ms, shape)
+                keys = [k.fn(env2) for k in distinct_keys]
+                valid = distinct_mask(keys, valid)
+            return TableData(cols, valid)
+
+        schema = ViewSchema(out_types, deferred)
+        return CompiledView(name, schema, scope_capacity, run)
+
+    # -- FROM / JOIN -----------------------------------------------------
+    def _view(self, table: str) -> ViewSchema:
+        if table not in self.catalog:
+            raise EngineException(f"unknown table '{table}'")
+        return self.catalog[table]
+
+    def _compile_from(self, sel: Select):
+        """Returns (scope, build_scope_fn, capacity).
+
+        build_scope_fn(tables, base_s, now) -> (scopes dict, valid, shape)
+        """
+        base = sel.from_table
+        base_schema = self._view(base.name)
+        base_cap = self.capacities[base.name]
+
+        if not sel.joins:
+            scope = Scope(
+                tables={base.binding: dict(base_schema.types)},
+                deferred={base.binding: self._deferred_exprs(base.binding, base_schema)},
+            )
+
+            def build(tables, base_s, now_rel_ms, b=base):
+                t = tables[b.name]
+                return {b.binding: t.cols}, t.valid, t.valid.shape
+
+            return scope, build, base_cap
+
+        # join chain: fold joins left-to-right into one merged table
+        bindings = [(base.binding, base.name, base_schema)]
+        for j in sel.joins:
+            bindings.append((j.table.binding, j.table.name, self._view(j.table.name)))
+        if len({b for b, _, _ in bindings}) != len(bindings):
+            raise EngineException("duplicate table bindings in join")
+
+        # merged column names: bare when unique, else qualified
+        all_cols: Dict[str, int] = {}
+        for _, _, sch in bindings:
+            for c in sch.types:
+                all_cols[c] = all_cols.get(c, 0) + 1
+            for c in sch.deferred:
+                all_cols[c] = all_cols.get(c, 0) + 1
+
+        def merged_name(binding: str, col: str) -> str:
+            return col if all_cols[col] == 1 else f"{binding}.{col}"
+
+        merged_types: Dict[str, str] = {}
+        merged_deferred: Dict[str, Tuple[DeferredPart, ...]] = {}
+        for b, _, sch in bindings:
+            for c, t in sch.types.items():
+                merged_types[merged_name(b, c)] = t
+            for c, parts in sch.deferred.items():
+                merged_deferred[merged_name(b, c)] = tuple(
+                    p if isinstance(p, str) else (merged_name(b, p[0]), p[1])
+                    for p in parts
+                )
+
+        merged_schema = ViewSchema(merged_types, merged_deferred)
+        out_cap = self._join_capacity(sel)
+
+        # compile each join's ON condition against the two-sided scope
+        join_plans = []
+        left_bindings = [bindings[0]]
+        for j, jb in zip(sel.joins, bindings[1:]):
+            lscope = Scope(
+                tables={b: dict(sch.types) for b, _, sch in left_bindings},
+            )
+            rscope = Scope(tables={jb[0]: dict(jb[2].types)})
+            eq_pairs, residual = self._split_on(j.on, lscope, rscope)
+            join_plans.append((j, jb, eq_pairs, residual, list(left_bindings)))
+            left_bindings.append(jb)
+
+        def build(tables, base_s, now_rel_ms):
+            # left side accumulates as a single merged col-dict keyed by
+            # (binding, col)
+            b0, n0, sch0 = bindings[0]
+            acc_cols = {(b0, c): tables[n0].cols[c] for c in sch0.types}
+            acc_valid = tables[n0].valid
+
+            for j, jb, eq_pairs, residual, lbs in join_plans:
+                rb, rn, rsch = jb
+                right = tables[rn]
+                shape_l = acc_valid.shape
+                shape_r = right.valid.shape
+                lscopes = {}
+                for (b, c), arr in acc_cols.items():
+                    lscopes.setdefault(b, {})[c] = arr
+                lenv = EvalEnv(lscopes, base_s, now_rel_ms, shape_l)
+                renv = EvalEnv({rb: right.cols}, base_s, now_rel_ms, shape_r)
+
+                lkeys = [le.fn(lenv) for le, _ in eq_pairs]
+                rkeys = [re_.fn(renv) for _, re_ in eq_pairs]
+
+                res_fn = None
+                if residual is not None:
+                    def res_fn(li, ri, residual=residual, lscopes=lscopes,
+                               right=right, rb=rb):
+                        pl_scopes = {
+                            b: {c: arr[li] for c, arr in cols.items()}
+                            for b, cols in lscopes.items()
+                        }
+                        pl_scopes[rb] = {c: arr[ri] for c, arr in right.cols.items()}
+                        env2 = EvalEnv(pl_scopes, base_s, now_rel_ms, li.shape)
+                        return residual.fn(env2)
+
+                if j.kind == "LEFT":
+                    li, ri, valid, is_null = left_join_indices(
+                        lkeys, rkeys, acc_valid, right.valid, out_cap, res_fn
+                    )
+                else:
+                    li, ri, valid = inner_join_indices(
+                        lkeys, rkeys, acc_valid, right.valid, out_cap, res_fn
+                    )
+                    is_null = None
+
+                new_cols = {}
+                for (b, c), arr in acc_cols.items():
+                    new_cols[(b, c)] = arr[li]
+                for c, arr in right.cols.items():
+                    gathered = arr[ri]
+                    if is_null is not None:
+                        gathered = jnp.where(is_null, jnp.zeros_like(gathered), gathered)
+                    new_cols[(rb, c)] = gathered
+                acc_cols = new_cols
+                acc_valid = valid
+
+            # merge to final names under a single "" binding + per-binding
+            final_scopes: Dict[str, Dict[str, jnp.ndarray]] = {"": {}}
+            for (b, c), arr in acc_cols.items():
+                final_scopes[""][merged_name(b, c)] = arr
+                final_scopes.setdefault(b, {})[c] = arr
+            return final_scopes, acc_valid, acc_valid.shape
+
+        # scope: merged columns under "" plus per-binding scopes
+        scope_tables = {"": dict(merged_types)}
+        scope_deferred = {"": self._deferred_exprs("", merged_schema)}
+        for b, _, sch in bindings:
+            scope_tables[b] = dict(sch.types)
+            scope_deferred[b] = self._deferred_exprs(b, sch)
+        scope = Scope(tables=scope_tables, deferred=scope_deferred)
+        return scope, build, out_cap
+
+    def _join_capacity(self, sel: Select) -> int:
+        caps = [self.capacities[sel.from_table.name]] + [
+            self.capacities[j.table.name] for j in sel.joins
+        ]
+        cap = max(caps)
+        return max(
+            self.config.min_join_capacity, int(cap * self.config.join_capacity_factor)
+        )
+
+    def _split_on(self, on: Expr, lscope: Scope, rscope: Scope):
+        """Split ON into equi pairs (left expr, right expr) + residual."""
+        conjuncts: List[Expr] = []
+
+        def walk(e: Expr):
+            if isinstance(e, BinOp) and e.op == "AND":
+                walk(e.left)
+                walk(e.right)
+            else:
+                conjuncts.append(e)
+
+        walk(on)
+        eq_pairs = []
+        residual_parts: List[Expr] = []
+        for c in conjuncts:
+            if isinstance(c, BinOp) and c.op == "=":
+                sides = []
+                for s in (c.left, c.right):
+                    side = self._side_of(s, lscope, rscope)
+                    sides.append(side)
+                if sides == ["L", "R"]:
+                    eq_pairs.append((c.left, c.right))
+                    continue
+                if sides == ["R", "L"]:
+                    eq_pairs.append((c.right, c.left))
+                    continue
+            residual_parts.append(c)
+        if not eq_pairs:
+            raise EngineException(
+                "JOIN requires at least one equality between the two tables"
+            )
+        compiled_pairs = [
+            (
+                ExprCompiler(lscope, self.dictionary, self.udfs).compile_device(le),
+                ExprCompiler(rscope, self.dictionary, self.udfs).compile_device(re_),
+            )
+            for le, re_ in eq_pairs
+        ]
+        residual = None
+        if residual_parts:
+            expr = residual_parts[0]
+            for p in residual_parts[1:]:
+                expr = BinOp("AND", expr, p)
+            both = Scope(
+                tables={**lscope.tables, **rscope.tables},
+            )
+            residual = ExprCompiler(both, self.dictionary, self.udfs).compile_device(expr)
+        return compiled_pairs, residual
+
+    def _side_of(self, e: Expr, lscope: Scope, rscope: Scope) -> str:
+        """Which side an expression's columns come from: 'L', 'R', or '?'."""
+        cols: List[Col] = []
+
+        def walk(x):
+            if isinstance(x, Col):
+                cols.append(x)
+            for attr in ("left", "right", "operand", "expr"):
+                sub = getattr(x, attr, None)
+                if sub is not None and not isinstance(sub, (str, tuple)):
+                    walk(sub)
+            if isinstance(x, Func):
+                for a in x.args:
+                    if not isinstance(a, Star):
+                        walk(a)
+
+        walk(e)
+        if not cols:
+            return "?"
+        sides = set()
+        for c in cols:
+            inl = self._resolves(lscope, c)
+            inr = self._resolves(rscope, c)
+            if inl and not inr:
+                sides.add("L")
+            elif inr and not inl:
+                sides.add("R")
+            else:
+                sides.add("?")
+        return sides.pop() if len(sides) == 1 else "?"
+
+    @staticmethod
+    def _resolves(scope: Scope, c: Col) -> bool:
+        try:
+            scope.resolve(c.parts)
+            return True
+        except EngineException:
+            return False
+
+    # -- select item expansion -------------------------------------------
+    def _deferred_exprs(
+        self, binding: str, schema: ViewSchema
+    ) -> Dict[str, HostStr]:
+        out = {}
+        for col, parts in schema.deferred.items():
+            new_parts: List[Union[str, CompiledExpr]] = []
+            deps: Tuple[Tuple[str, str], ...] = ()
+            for p in parts:
+                if isinstance(p, str):
+                    new_parts.append(p)
+                else:
+                    hidden, t = p
+                    new_parts.append(
+                        CompiledExpr(
+                            t,
+                            lambda env, b=binding, c=hidden: env.column(b, c),
+                            deps=((binding, hidden),),
+                        )
+                    )
+                    deps += ((binding, hidden),)
+            out[col] = HostStr(new_parts, deps)
+        return out
+
+    def _expand_item(
+        self, item: SelectItem, scope: Scope, compiler: ExprCompiler
+    ) -> List[Tuple[str, Value]]:
+        if isinstance(item.expr, Star):
+            out = []
+            bindings = (
+                [item.expr.table] if item.expr.table else
+                [b for b in scope.tables if b != "" or len(scope.tables) == 1]
+            )
+            # for join scopes prefer the merged "" binding to avoid dupes
+            if "" in scope.tables and item.expr.table is None:
+                bindings = [""]
+            for b in bindings:
+                for c, t in scope.tables[b].items():
+                    if c.startswith("__defer."):
+                        continue
+                    out.append(
+                        (
+                            c,
+                            CompiledExpr(
+                                t,
+                                lambda env, b=b, c=c: env.column(b, c),
+                                deps=((b, c),),
+                            ),
+                        )
+                    )
+                for c, h in scope.deferred.get(b, {}).items():
+                    out.append((c, h))
+            return out
+
+        value = compiler.compile(item.expr)
+        name = item.alias
+        if name is None:
+            if isinstance(item.expr, Col):
+                name = item.expr.parts[-1]
+            else:
+                raise EngineException(
+                    f"select expression requires an alias: {item.expr!r}"
+                )
+        return [(name, value)]
+
+    def _flatten_outputs(self, out_values: List[Tuple[str, Value]]):
+        """Flatten named Values into device columns + deferred templates.
+
+        Returns (types, deferred, flat: [(col_name, fn)]).
+        """
+        types: Dict[str, str] = {}
+        deferred: Dict[str, Tuple[DeferredPart, ...]] = {}
+        flat: List[Tuple[str, Callable]] = []
+
+        def add_device(col: str, ce: CompiledExpr):
+            if col in types:
+                raise EngineException(f"duplicate output column {col}")
+            types[col] = ce.type
+            flat.append((col, ce.fn))
+
+        def walk(prefix: str, v: Value):
+            if isinstance(v, CompiledExpr):
+                add_device(prefix, v)
+            elif isinstance(v, StructValue):
+                if v.validity is not None:
+                    add_device(prefix + ".__valid", v.validity)
+                for f, sub in v.fields.items():
+                    walk(prefix + "." + f, sub)
+            elif isinstance(v, ArrayValue):
+                for i, el in enumerate(v.elements):
+                    if isinstance(el, StructValue) and el.validity is None:
+                        el = StructValue(el.fields, validity=CompiledExpr(
+                            "boolean",
+                            lambda env: jnp.broadcast_to(jnp.asarray(True), env.shape),
+                        ))
+                    walk(f"{prefix}.{i}", el)
+            elif isinstance(v, HostStr):
+                parts: List[DeferredPart] = []
+                for i, p in enumerate(v.parts):
+                    if isinstance(p, str):
+                        parts.append(p)
+                    else:
+                        hidden = f"__defer.{prefix}.{i}"
+                        add_device(hidden, p)
+                        parts.append((hidden, p.type))
+                deferred[prefix] = tuple(parts)
+            else:
+                raise EngineException(f"cannot output value {v!r}")
+
+        for name, v in out_values:
+            walk(name, v)
+        return types, deferred, flat
+
+    def _distinct_key_exprs(self, out_values) -> List[CompiledExpr]:
+        keys: List[CompiledExpr] = []
+        for _, v in out_values:
+            keys.extend(self._device_keys_of(v))
+        return keys
+
+    def _device_keys_of(self, v: Value) -> List[CompiledExpr]:
+        if isinstance(v, CompiledExpr):
+            return [v]
+        if isinstance(v, StructValue):
+            out = []
+            if v.validity is not None:
+                out.append(v.validity)
+            for sub in v.fields.values():
+                out.extend(self._device_keys_of(sub))
+            return out
+        if isinstance(v, ArrayValue):
+            out = []
+            for el in v.elements:
+                out.extend(self._device_keys_of(el))
+            return out
+        if isinstance(v, HostStr):
+            return [p for p in v.parts if isinstance(p, CompiledExpr)]
+        return []
+
+    # -- grouped path ----------------------------------------------------
+    def _compile_grouped(
+        self, name, sel, scope, compiler, build_scope, scope_capacity,
+        where_fn, out_types, deferred, flat_outputs, out_values,
+    ) -> CompiledView:
+        # group keys: resolve against select aliases first, then scope
+        alias_map = {}
+        for item in sel.items:
+            if item.alias is not None:
+                alias_map[item.alias.lower()] = item.expr
+        key_exprs: List[Expr] = []
+        for g in sel.group_by:
+            if isinstance(g, Col) and len(g.parts) == 1 and g.parts[0].lower() in alias_map:
+                key_exprs.append(alias_map[g.parts[0].lower()])
+            else:
+                key_exprs.append(g)
+
+        key_compiled: List[CompiledExpr] = []
+        plain = ExprCompiler(scope, self.dictionary, self.udfs)
+        for g in key_exprs:
+            v = plain.compile(g)
+            if isinstance(v, HostStr):
+                key_compiled.extend(
+                    p for p in v.parts if isinstance(p, CompiledExpr)
+                )
+            elif is_device(v):
+                key_compiled.append(v)
+            else:
+                raise EngineException(f"cannot group by composite value {g!r}")
+
+        agg_nodes = compiler.agg_nodes  # populated during _expand_item
+        agg_args: Dict[str, Optional[CompiledExpr]] = {}
+        for key, (fname, arg, dist) in agg_nodes.items():
+            agg_args[key] = (
+                None if arg is None else plain.compile_device(arg, f"{fname} argument")
+            )
+
+        capacity = min(scope_capacity, self.config.max_group_capacity)
+
+        def run(tables, base_s, now_rel_ms):
+            scopes, valid, shape = build_scope(tables, base_s, now_rel_ms)
+            env = EvalEnv(scopes, base_s, now_rel_ms, shape)
+            if where_fn is not None:
+                valid = valid & where_fn(env)
+
+            keys = [k.fn(env) for k in key_compiled]
+            order, seg, num_groups, first = group_ids(keys, valid)
+            valid_s = valid[order]
+
+            # aggregate values
+            agg_results: Dict[str, jnp.ndarray] = {}
+            for key, (fname, arg, dist) in agg_nodes.items():
+                if fname == "COUNT" and agg_args[key] is None:
+                    agg_results[key] = segment_aggregate(
+                        None, seg, capacity, "count", valid_s
+                    )
+                    continue
+                vals = agg_args[key].fn(env)[order]
+                if fname == "COUNT" and dist:
+                    agg_results[key] = _distinct_count(
+                        agg_args[key].fn(env), order, seg, valid_s, capacity
+                    )
+                elif fname == "COUNT":
+                    agg_results[key] = segment_aggregate(
+                        None, seg, capacity, "count", valid_s
+                    )
+                elif fname == "SUM":
+                    z = jnp.where(valid_s, vals, jnp.zeros_like(vals))
+                    agg_results[key] = segment_aggregate(
+                        z, seg, capacity, "sum", valid_s
+                    )
+                elif fname == "AVG":
+                    zf = jnp.where(valid_s, vals, jnp.zeros_like(vals)).astype(
+                        jnp.float32
+                    )
+                    s = segment_aggregate(zf, seg, capacity, "sum", valid_s)
+                    c = segment_aggregate(None, seg, capacity, "count", valid_s)
+                    agg_results[key] = s / jnp.maximum(c, 1).astype(jnp.float32)
+                elif fname in ("MIN", "MAX"):
+                    op = fname.lower()
+                    ident = (
+                        jnp.iinfo(jnp.int32).max if vals.dtype in (jnp.int32,)
+                        else jnp.asarray(jnp.inf, vals.dtype)
+                    )
+                    if fname == "MAX":
+                        ident = (
+                            jnp.iinfo(jnp.int32).min if vals.dtype in (jnp.int32,)
+                            else jnp.asarray(-jnp.inf, vals.dtype)
+                        )
+                    z = jnp.where(valid_s, vals, jnp.full_like(vals, ident))
+                    agg_results[key] = segment_aggregate(z, seg, capacity, op, valid_s)
+
+            # representative row per group (first sorted row)
+            rep_sorted_idx, rep_valid = compact_indices(first, capacity)
+            rep_idx = order[rep_sorted_idx]
+
+            rep_scopes = {
+                b: {c: arr[rep_idx] for c, arr in cols.items()}
+                for b, cols in scopes.items()
+            }
+            rep_scopes["__agg"] = agg_results
+            group_env = EvalEnv(rep_scopes, base_s, now_rel_ms, (capacity,))
+
+            cols = {n: fn(group_env) for n, fn in flat_outputs}
+            out_valid = jnp.arange(capacity) < num_groups
+            return TableData(cols, out_valid)
+
+        schema = ViewSchema(out_types, deferred)
+        return CompiledView(name, schema, capacity, run)
+
+
+def _distinct_count(vals, order, seg, valid_s, capacity):
+    """COUNT(DISTINCT x) per group: sort (seg, x) pairs, count pair-firsts."""
+    x_s = vals[order]
+    pair_order = jnp.lexsort([x_s.astype(jnp.int32), seg])
+    seg_p = seg[pair_order]
+    x_p = x_s[pair_order]
+    valid_p = valid_s[pair_order]
+    new_pair = jnp.concatenate(
+        [
+            jnp.ones((1,), jnp.bool_),
+            (seg_p[1:] != seg_p[:-1]) | (x_p[1:] != x_p[:-1]),
+        ]
+    )
+    flags = (new_pair & valid_p).astype(jnp.int32)
+    out = segment_aggregate(flags, seg_p, capacity, "sum", valid_p)
+    return out

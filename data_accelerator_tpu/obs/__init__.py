@@ -1,0 +1,6 @@
+"""Observability: metric store, metric logger, telemetry."""
+
+from .store import MetricStore, METRIC_STORE
+from .metrics import MetricLogger
+
+__all__ = ["MetricStore", "METRIC_STORE", "MetricLogger"]

@@ -1,0 +1,1 @@
+"""Micro-batch streaming runtime: hosts, sources, sinks, state, checkpoints."""

@@ -1,0 +1,102 @@
+"""Offset checkpointing with the reference's file semantics.
+
+reference: datax-host checkpoint/EventhubCheckpointer.scala:13-74 —
+``offsets.txt`` holds one line per partition
+``<ts>,<source>,<partition>,<fromSeq>,<untilSeq>``; before each write the
+previous file is copied to ``offsets.txt.old``; on (re)start offsets are
+read (falling back to the .old backup) and applied as starting positions.
+At-least-once: a crash between sink write and checkpoint replays the
+last batch.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class PartitionOffset:
+    ts_ms: int
+    source: str
+    partition: int
+    from_seq: int
+    until_seq: int
+
+
+class OffsetCheckpointer:
+    FILE = "offsets.txt"
+    BACKUP = "offsets.txt.old"
+
+    def __init__(self, checkpoint_dir: str):
+        self.dir = checkpoint_dir
+        os.makedirs(checkpoint_dir, exist_ok=True)
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.dir, self.FILE)
+
+    @property
+    def backup_path(self) -> str:
+        return os.path.join(self.dir, self.BACKUP)
+
+    def write_offsets(self, offsets: List[PartitionOffset]) -> None:
+        """Backup then write, as the reference does (scala :43-61)."""
+        if os.path.exists(self.path):
+            shutil.copyfile(self.path, self.backup_path)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for o in offsets:
+                f.write(
+                    f"{o.ts_ms},{o.source},{o.partition},{o.from_seq},{o.until_seq}\n"
+                )
+        os.replace(tmp, self.path)
+
+    def read_offsets(self) -> List[PartitionOffset]:
+        """Read current file, falling back to the backup (scala :63-73)."""
+        for path in (self.path, self.backup_path):
+            if os.path.exists(path):
+                try:
+                    return self._parse(path)
+                except Exception:
+                    continue
+        return []
+
+    @staticmethod
+    def _parse(path: str) -> List[PartitionOffset]:
+        out = []
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                ts, source, part, from_seq, until_seq = line.split(",")
+                out.append(
+                    PartitionOffset(
+                        int(ts), source, int(part), int(from_seq), int(until_seq)
+                    )
+                )
+        return out
+
+    def starting_positions(self) -> Dict[Tuple[str, int], int]:
+        """(source, partition) -> next sequence number to read."""
+        return {
+            (o.source, o.partition): o.until_seq for o in self.read_offsets()
+        }
+
+    def checkpoint_batch(
+        self, consumed: Dict[Tuple[str, int], Tuple[int, int]]
+    ) -> None:
+        """consumed: (source, partition) -> (from_seq, until_seq)."""
+        now = int(time.time() * 1000)
+        merged: Dict[Tuple[str, int], PartitionOffset] = {
+            (o.source, o.partition): o for o in self.read_offsets()
+        }
+        for (source, part), (from_seq, until_seq) in consumed.items():
+            merged[(source, part)] = PartitionOffset(
+                now, source, part, from_seq, until_seq
+            )
+        self.write_offsets(list(merged.values()))

@@ -1,0 +1,151 @@
+"""StreamingHost: the micro-batch driver loop.
+
+reference: datax-host host/StreamingHost.scala:22-97 — build config,
+create the processor, wire the input stream, then per batch: process,
+emit metrics, checkpoint offsets every checkpointInterval; per-batch
+failures log + rethrow so the batch retries (at-least-once,
+CommonProcessorFactory.scala:382-398).
+
+Run one-box:
+    python -m data_accelerator_tpu.runtime.host conf=<flow>.conf batches=10
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+from typing import Dict, Optional
+
+from ..core.config import SettingDictionary, SettingNamespace
+from ..core.confmanager import ConfigManager
+from ..obs.metrics import MetricLogger
+from .checkpoint import OffsetCheckpointer
+from .processor import FlowProcessor
+from .sinks import OutputDispatcher, build_output_operators
+from .sources import LocalSource, StreamingSource, make_source
+
+logger = logging.getLogger(__name__)
+
+
+class StreamingHost:
+    def __init__(
+        self,
+        dict_: SettingDictionary,
+        source: Optional[StreamingSource] = None,
+        udfs: Optional[dict] = None,
+        table_sink_map: Optional[Dict[str, list]] = None,
+    ):
+        self.dict = dict_
+        self.processor = FlowProcessor(dict_, udfs=udfs)
+        self.metric_logger = MetricLogger.from_conf(dict_)
+
+        input_conf = dict_.get_sub_dictionary(SettingNamespace.JobInputPrefix)
+        self.source = source or make_source(input_conf, self.processor.input_schema)
+        self.interval_s = self.processor.interval_s
+        self.max_rate = int(input_conf.get_or_else("eventhub.maxrate", "1000"))
+
+        # offset checkpointing (EventhubCheckpointer semantics)
+        ckpt_dir = input_conf.get("eventhub.checkpointdir") or input_conf.get(
+            "streaming.checkpointdir"
+        )
+        self.checkpointer = (
+            OffsetCheckpointer(ckpt_dir) if ckpt_dir else None
+        )
+        self.checkpoint_interval_s = (
+            input_conf.get_duration_option("eventhub.checkpointinterval") or 60.0
+        )
+        self._last_checkpoint = 0.0
+        if self.checkpointer:
+            self.source.start(self.checkpointer.starting_positions())
+
+        # sink routing: dataset -> output names; default: each conf output
+        # name routes its same-named dataset (S500 contract)
+        if table_sink_map is None:
+            conf_outputs = dict_.get_sub_dictionary(
+                SettingNamespace.JobOutputPrefix
+            ).group_by_sub_namespace()
+            table_sink_map = {name: [name] for name in conf_outputs}
+        operators = build_output_operators(dict_, self.metric_logger, table_sink_map)
+        self.dispatcher = OutputDispatcher(operators, self.metric_logger)
+
+        self.batches_processed = 0
+        self._stop = False
+
+    # -- loop -------------------------------------------------------------
+    def run_batch(self) -> Dict[str, float]:
+        """One micro-batch: poll -> encode -> device step -> sinks ->
+        metrics -> checkpoint."""
+        t0 = time.time()
+        batch_time_ms = int(t0 * 1000)
+        max_events = min(
+            self.processor.batch_capacity, int(self.max_rate * self.interval_s)
+        )
+
+        if isinstance(self.source, LocalSource):
+            cols, now_ms, consumed = self.source.poll_columns(
+                max_events, self.processor.dictionary
+            )
+            raw = self.processor.encode_columns(cols, max_events)
+            batch_time_ms = now_ms
+        else:
+            rows, consumed = self.source.poll(max_events)
+            raw = self.processor.encode_rows(rows, (batch_time_ms // 1000) * 1000)
+
+        try:
+            datasets, metrics = self.processor.process_batch(raw, batch_time_ms)
+            self.dispatcher.dispatch(datasets, batch_time_ms)
+            self.processor.commit()
+        except Exception:
+            logger.exception("batch processing failed; rethrowing for retry")
+            raise
+
+        metrics["Latency-Batch"] = (time.time() - t0) * 1000.0
+        self.metric_logger.send_batch_metrics(metrics, batch_time_ms)
+
+        if self.checkpointer and (
+            t0 - self._last_checkpoint >= self.checkpoint_interval_s
+        ):
+            self.checkpointer.checkpoint_batch(consumed)
+            self._last_checkpoint = t0
+
+        self.batches_processed += 1
+        return metrics
+
+    def run(self, max_batches: Optional[int] = None) -> None:
+        """Paced loop (streaming.intervalInSeconds cadence,
+        StreamingHost.scala:66-67)."""
+        while not self._stop:
+            start = time.time()
+            self.run_batch()
+            if max_batches is not None and self.batches_processed >= max_batches:
+                break
+            sleep = self.interval_s - (time.time() - start)
+            if sleep > 0:
+                time.sleep(sleep)
+
+    def stop(self) -> None:
+        self._stop = True
+        self.source.close()
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    args = argv if argv is not None else sys.argv[1:]
+    named = {
+        a.split("=", 1)[0]: a.split("=", 1)[1] for a in args if "=" in a
+    }
+    ConfigManager.reset()
+    ConfigManager.get_configuration_from_arguments(args)
+    d = ConfigManager.load_config()
+    host = StreamingHost(d)
+    max_batches = int(named["batches"]) if "batches" in named else None
+    logger.info(
+        "starting flow %s (interval=%ss, capacity=%s)",
+        d.get_job_name(), host.interval_s, host.processor.batch_capacity,
+    )
+    host.run(max_batches)
+
+
+if __name__ == "__main__":
+    main()

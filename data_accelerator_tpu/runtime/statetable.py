@@ -1,0 +1,131 @@
+"""Accumulation (state) tables with A/B active-standby persistence.
+
+reference: datax-host handler/StateTableHandler.scala:17-129 — each
+``--DataXStates--`` table persists as two Parquet dirs A/B plus a
+``metadata.info`` pointer naming the active one; a batch writes the new
+state into the standby dir, flips the pointer in memory, and persist()
+writes the pointer file after outputs succeed. Restart loads the dir the
+pointer names — crash between write and persist leaves the old state
+active (consistent with at-least-once replay).
+
+Here a table snapshot is a ``.npz`` of column arrays + validity + a JSON
+sidecar with types and the string-dictionary entries its ids reference.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..compile.planner import TableData, ViewSchema
+from ..core.schema import StringDictionary
+
+
+@dataclass
+class StateTable:
+    name: str
+    schema: ViewSchema
+    capacity: int
+    location: str  # base dir holding A/, B/, metadata.info
+
+    def __post_init__(self):
+        os.makedirs(self.location, exist_ok=True)
+        self._active = self._read_pointer() or "A"
+
+    # -- pointer ---------------------------------------------------------
+    @property
+    def _pointer_path(self) -> str:
+        return os.path.join(self.location, "metadata.info")
+
+    def _read_pointer(self) -> Optional[str]:
+        try:
+            with open(self._pointer_path, "r", encoding="utf-8") as f:
+                p = f.read().strip()
+                return p if p in ("A", "B") else None
+        except FileNotFoundError:
+            return None
+
+    @property
+    def active(self) -> str:
+        return self._active
+
+    @property
+    def standby(self) -> str:
+        return "B" if self._active == "A" else "A"
+
+    # -- load/store ------------------------------------------------------
+    def _dir(self, which: str) -> str:
+        return os.path.join(self.location, which)
+
+    def load(self, dictionary: StringDictionary) -> TableData:
+        """Load the active snapshot; empty table if none exists yet."""
+        d = self._dir(self._active)
+        npz_path = os.path.join(d, "table.npz")
+        meta_path = os.path.join(d, "meta.json")
+        if not (os.path.exists(npz_path) and os.path.exists(meta_path)):
+            return self.empty()
+        with open(meta_path, "r", encoding="utf-8") as f:
+            meta = json.load(f)
+        data = np.load(npz_path)
+        # remap persisted dictionary ids into the live dictionary
+        id_map = {int(k): dictionary.encode(v) for k, v in meta["strings"].items()}
+        cols: Dict[str, jnp.ndarray] = {}
+        for col, t in self.schema.types.items():
+            arr = data[col]
+            if t == "string" and id_map:
+                lut_keys = np.array(list(id_map.keys()), dtype=np.int64)
+                lut_vals = np.array(list(id_map.values()), dtype=np.int64)
+                remap = np.zeros(int(lut_keys.max()) + 1, dtype=np.int32)
+                remap[lut_keys] = lut_vals.astype(np.int32)
+                arr = np.where(
+                    (arr >= 0) & (arr < len(remap)), remap[np.clip(arr, 0, None)], 0
+                ).astype(np.int32)
+            cols[col] = jnp.asarray(arr)
+        valid = jnp.asarray(data["__valid"])
+        return TableData(cols, valid)
+
+    def overwrite(self, table: TableData, dictionary: StringDictionary) -> None:
+        """Write new state into the standby dir and flip in memory
+        (StateTableHandler.scala:99-115)."""
+        d = self._dir(self.standby)
+        os.makedirs(d, exist_ok=True)
+        cols = {k: np.asarray(v) for k, v in table.cols.items()}
+        valid = np.asarray(table.valid)
+        strings: Dict[str, str] = {}
+        for col, t in self.schema.types.items():
+            if t == "string":
+                for sid in np.unique(cols[col][valid]):
+                    s = dictionary.decode(int(sid))
+                    if s is not None:
+                        strings[str(int(sid))] = s
+        np.savez(
+            os.path.join(d, "table.npz"),
+            __valid=valid,
+            **{c: cols[c] for c in self.schema.types},
+        )
+        with open(os.path.join(d, "meta.json"), "w", encoding="utf-8") as f:
+            json.dump({"types": self.schema.types, "strings": strings}, f)
+        self._active = self.standby  # flip in memory; persist() commits
+
+    def persist(self) -> None:
+        """Commit the pointer after outputs succeed
+        (StateTableHandler.scala:117-125)."""
+        tmp = self._pointer_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(self._active)
+        os.replace(tmp, self._pointer_path)
+
+    def empty(self) -> TableData:
+        cols = {
+            c: jnp.zeros(
+                (self.capacity,),
+                dtype={"double": jnp.float32, "boolean": jnp.bool_}.get(t, jnp.int32),
+            )
+            for c, t in self.schema.types.items()
+        }
+        return TableData(cols, jnp.zeros((self.capacity,), dtype=jnp.bool_))

@@ -1,0 +1,319 @@
+"""SQL engine tests: parse -> plan -> jax execution vs python-computed
+expectations, over the query shapes the reference's flows actually use."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from data_accelerator_tpu.compile.pipeline import (
+    Pipeline,
+    PipelineCompiler,
+    parse_state_table_schema,
+)
+from data_accelerator_tpu.compile.planner import TableData, ViewSchema
+from data_accelerator_tpu.core.config import EngineException
+from data_accelerator_tpu.core.schema import StringDictionary
+
+
+def make_table(cols, n=None, capacity=None):
+    arrays = {}
+    length = None
+    for k, v in cols.items():
+        a = np.asarray(v)
+        length = len(a)
+        arrays[k] = a
+    capacity = capacity or length
+    n = n if n is not None else length
+    out = {}
+    for k, a in arrays.items():
+        pad = np.zeros(capacity, dtype=a.dtype)
+        pad[:length] = a
+        out[k] = jnp.asarray(pad)
+    valid = np.zeros(capacity, bool)
+    valid[:n] = True
+    return TableData(out, jnp.asarray(valid))
+
+
+def run_pipeline(transform, inputs_data, types, dictionary=None, state_tables=None,
+                 state_data=None, base_s=1_700_000_000, now_rel_ms=5_000):
+    d = dictionary or StringDictionary()
+    inputs = {
+        name: (ViewSchema(types[name]), inputs_data[name].capacity)
+        for name in inputs_data
+    }
+    st = None
+    if state_tables:
+        st = {
+            name: (parse_state_table_schema(ddl), state_data[name].capacity)
+            for name, ddl in state_tables.items()
+        }
+    pc = PipelineCompiler(d)
+    pipe = pc.compile_transform(transform, inputs, st)
+    tables = dict(inputs_data)
+    if state_data:
+        tables.update(state_data)
+    out = pipe.run(
+        tables, jnp.asarray(base_s, jnp.int32), jnp.asarray(now_rel_ms, jnp.int32)
+    )
+    return pipe, out, d
+
+
+def rows_of(table: TableData, *cols):
+    valid = np.asarray(table.valid)
+    out = []
+    for i in np.nonzero(valid)[0]:
+        out.append(tuple(np.asarray(table.cols[c])[i].item() for c in cols))
+    return out
+
+
+def test_projection_filter():
+    t = make_table({"a": np.int32([1, 2, 3, 4]), "b": np.float32([1.5, 2.5, 3.5, 4.5])})
+    _, out, _ = run_pipeline(
+        "--DataXQuery--\nv = SELECT a, b * 2 AS b2 FROM t WHERE a >= 2",
+        {"t": t}, {"t": {"a": "long", "b": "double"}},
+    )
+    assert sorted(rows_of(out["v"], "a", "b2")) == [(2, 5.0), (3, 7.0), (4, 9.0)]
+
+
+def test_string_equality_and_literal_columns():
+    d = StringDictionary()
+    door = d.encode("DoorLock")
+    heat = d.encode("Heating")
+    t = make_table({
+        "deviceType": np.int32([door, heat, door]),
+        "status": np.int32([1, 0, 0]),
+    })
+    _, out, d2 = run_pipeline(
+        "--DataXQuery--\nv = SELECT status, 'alert' AS kind FROM t "
+        "WHERE deviceType = 'DoorLock' AND status = 0",
+        {"t": t}, {"t": {"deviceType": "string", "status": "long"}},
+        dictionary=d,
+    )
+    rows = rows_of(out["v"], "status", "kind")
+    assert len(rows) == 1
+    assert d2.decode(rows[0][1]) == "alert"
+
+
+def test_group_by_aggregates():
+    t = make_table({
+        "deviceId": np.int32([1, 2, 1, 2, 1]),
+        "status": np.int32([5, 1, 3, 9, 4]),
+    })
+    _, out, _ = run_pipeline(
+        "--DataXQuery--\nagg = SELECT deviceId, MIN(status) AS MinReading, "
+        "MAX(status) AS MaxReading, COUNT(*) AS Count, AVG(status) AS avgs "
+        "FROM t GROUP BY deviceId",
+        {"t": t}, {"t": {"deviceId": "long", "status": "long"}},
+    )
+    rows = sorted(rows_of(out["agg"], "deviceId", "MinReading", "MaxReading", "Count"))
+    assert rows == [(1, 3, 5, 3), (2, 1, 9, 2)]
+    avg = {r[0]: r[1] for r in rows_of(out["agg"], "deviceId", "avgs")}
+    assert avg[1] == pytest.approx(4.0)
+    assert avg[2] == pytest.approx(5.0)
+
+
+def test_group_by_alias_reference():
+    # GROUP BY on select aliases, the CreateMetric pattern
+    t = make_table({"s": np.int32([1, 1, 0])})
+    _, out, _ = run_pipeline(
+        "--DataXQuery--\nm = SELECT s AS Metric, 'M' AS MetricName FROM t "
+        "GROUP BY Metric, MetricName",
+        {"t": t}, {"t": {"s": "long"}},
+    )
+    assert sorted(rows_of(out["m"], "Metric")) == [(0,), (1,)]
+
+
+def test_count_distinct():
+    t = make_table({
+        "g": np.int32([1, 1, 1, 2, 2]),
+        "x": np.int32([10, 10, 20, 30, 30]),
+    })
+    _, out, _ = run_pipeline(
+        "--DataXQuery--\nv = SELECT g, COUNT(DISTINCT x) AS dc FROM t GROUP BY g",
+        {"t": t}, {"t": {"g": "long", "x": "long"}},
+    )
+    assert sorted(rows_of(out["v"], "g", "dc")) == [(1, 2), (2, 1)]
+
+
+def test_global_aggregate_no_group_by():
+    t = make_table({"x": np.int32([3, 7, 5])})
+    _, out, _ = run_pipeline(
+        "--DataXQuery--\nv = SELECT MAX(x) AS mx, COUNT(*) AS c FROM t",
+        {"t": t}, {"t": {"x": "long"}},
+    )
+    assert rows_of(out["v"], "mx", "c") == [(7, 3)]
+
+
+def test_join_refdata():
+    d = StringDictionary()
+    names = [d.encode(s) for s in ["front", "back", "garage"]]
+    events = make_table({
+        "deviceId": np.int32([1, 2, 3, 1]),
+        "homeId": np.int32([150, 150, 99, 150]),
+        "status": np.int32([0, 1, 0, 1]),
+    })
+    ref = make_table({
+        "deviceId": np.int32([1, 2]),
+        "homeId": np.int32([150, 150]),
+        "deviceName": np.int32(names[:2]),
+    })
+    _, out, d2 = run_pipeline(
+        "--DataXQuery--\nj = SELECT t.deviceId, t.status, r.deviceName FROM t "
+        "JOIN r ON t.deviceId = r.deviceId AND t.homeId = r.homeId",
+        {"t": events, "r": ref},
+        {
+            "t": {"deviceId": "long", "homeId": "long", "status": "long"},
+            "r": {"deviceId": "long", "homeId": "long", "deviceName": "string"},
+        },
+        dictionary=d,
+    )
+    rows = sorted(rows_of(out["j"], "deviceId", "status"))
+    assert rows == [(1, 0), (1, 1), (2, 1)]
+
+
+def test_join_with_residual_condition():
+    l = make_table({"k": np.int32([1, 1]), "v": np.int32([10, 30])})
+    r = make_table({"k": np.int32([1]), "w": np.int32([20])})
+    _, out, _ = run_pipeline(
+        "--DataXQuery--\nj = SELECT v, w FROM l JOIN r ON l.k = r.k AND l.v > r.w",
+        {"l": l, "r": r},
+        {"l": {"k": "long", "v": "long"}, "r": {"k": "long", "w": "long"}},
+    )
+    assert rows_of(out["j"], "v", "w") == [(30, 20)]
+
+
+def test_union_all():
+    t1 = make_table({"a": np.int32([1, 2])})
+    t2 = make_table({"a": np.int32([3])})
+    _, out, _ = run_pipeline(
+        "--DataXQuery--\nu = SELECT a FROM t1 UNION ALL SELECT a FROM t2",
+        {"t1": t1, "t2": t2}, {"t1": {"a": "long"}, "t2": {"a": "long"}},
+    )
+    assert sorted(rows_of(out["u"], "a")) == [(1,), (2,), (3,)]
+    assert out["u"].capacity == 3
+
+
+def test_distinct():
+    t = make_table({"a": np.int32([1, 1, 2, 2, 3])})
+    _, out, _ = run_pipeline(
+        "--DataXQuery--\nv = SELECT DISTINCT a FROM t",
+        {"t": t}, {"t": {"a": "long"}},
+    )
+    assert sorted(rows_of(out["v"], "a")) == [(1,), (2,), (3,)]
+
+
+def test_multi_statement_chaining_and_map_access():
+    t = make_table({
+        "IoTDeviceId": np.int32([1, 1, 2]),
+        "temperature": np.float32([50.0, 100.0, 80.0]),
+    })
+    transform = (
+        "--DataXQuery--\n"
+        "batch5s = SELECT IoTDeviceId AS __deviceid, "
+        "MAP('avg', AVG(temperature), 'max', MAX(temperature)) AS temperature "
+        "FROM t GROUP BY IoTDeviceId\n"
+        "--DataXQuery--\n"
+        "alert = SELECT __deviceid, temperature.avg AS avg_t FROM batch5s "
+        "WHERE temperature.avg > 70"
+    )
+    _, out, _ = run_pipeline(
+        transform, {"t": t},
+        {"t": {"IoTDeviceId": "long", "temperature": "double"}},
+    )
+    rows = dict(rows_of(out["alert"], "__deviceid", "avg_t"))
+    # device 1: (50+100)/2 = 75, device 2: 80 — both exceed 70
+    assert rows[1] == pytest.approx(75.0)
+    assert rows[2] == pytest.approx(80.0)
+
+
+def test_concat_deferred_string():
+    d = StringDictionary()
+    nm = d.encode("front")
+    t = make_table({"deviceName": np.int32([nm]), "homeId": np.int32([150])})
+    pipe, out, d2 = run_pipeline(
+        "--DataXQuery--\nv = SELECT CONCAT('Door unlocked: ', deviceName, "
+        "' at home ', homeId) AS Pivot1, homeId FROM t",
+        {"t": t}, {"t": {"deviceName": "string", "homeId": "long"}},
+        dictionary=d,
+    )
+    sch = pipe.schema_of("v")
+    assert "Pivot1" in sch.deferred
+    from data_accelerator_tpu.runtime.materialize import materialize_rows
+
+    rows = materialize_rows(out["v"], sch, d2)
+    assert rows[0]["Pivot1"] == "Door unlocked: front at home 150"
+    assert rows[0]["homeId"] == 150
+
+
+def test_timestamp_functions():
+    # DATE_TRUNC + unix_timestamp arithmetic on the relative encoding
+    t = make_table({"ts": np.int32([1500, 2500])})  # rel ms
+    _, out, _ = run_pipeline(
+        "--DataXQuery--\nv = SELECT DATE_TRUNC('second', ts) AS sec, "
+        "unix_timestamp() - to_unix_timestamp(ts) AS agesec, "
+        "hour(ts) AS h FROM t",
+        {"t": t}, {"t": {"ts": "timestamp"}},
+        base_s=1_700_000_000, now_rel_ms=10_000,
+    )
+    rows = rows_of(out["v"], "sec", "agesec", "h")
+    assert rows[0] == (1000, 9, ((1_700_000_000 + 1) // 3600) % 24)
+    assert rows[1][0] == 2000
+
+
+def test_accumulation_table_cycle():
+    acc_ddl = "deviceId long, Reading long"
+    acc = make_table({"deviceId": np.int32([7]), "Reading": np.int32([1])})
+    t = make_table({"deviceId": np.int32([8]), "Reading": np.int32([2])})
+    transform = (
+        "--DataXQuery--\n"
+        "merged = SELECT deviceId, Reading FROM t "
+        "UNION ALL SELECT deviceId, Reading FROM acc\n"
+        "--DataXQuery--\n"
+        "acc = SELECT deviceId, Reading FROM merged"
+    )
+    pipe, out, _ = run_pipeline(
+        transform, {"t": t}, {"t": {"deviceId": "long", "Reading": "long"}},
+        state_tables={"acc": acc_ddl}, state_data={"acc": acc},
+    )
+    assert pipe.state_tables == ["acc"]
+    assert sorted(rows_of(out["acc"], "deviceId", "Reading")) == [(7, 1), (8, 2)]
+
+
+def test_simple_rule_filternull_array():
+    t = make_table({"Temperature": np.float32([95.0, 30.0])})
+    transform = (
+        "--DataXQuery--\n"
+        "Rules = SELECT *, filterNull(Array(IF(Temperature > 90, "
+        "MAP('ruleId', 'R1', 'severity', 'Critical'), NULL))) AS Rules FROM t"
+    )
+    pipe, out, d = run_pipeline(
+        transform, {"t": t}, {"t": {"Temperature": "double"}},
+    )
+    sch = pipe.schema_of("Rules")
+    assert "Rules.0.__valid" in sch.types
+    v = out["Rules"]
+    flags = np.asarray(v.cols["Rules.0.__valid"])
+    assert flags[0] and not flags[1]
+    rid = np.asarray(v.cols["Rules.0.ruleId"])
+    assert d.decode(int(rid[0])) == "R1"
+
+
+def test_pipeline_is_jittable():
+    t = make_table({"a": np.int32([1, 2, 3])})
+    d = StringDictionary()
+    pc = PipelineCompiler(d)
+    pipe = pc.compile_transform(
+        "--DataXQuery--\nv = SELECT a, a * 2 AS a2 FROM t WHERE a > 1",
+        {"t": (ViewSchema({"a": "long"}), 3)},
+    )
+    jitted = jax.jit(lambda tables, b, n: pipe.run(tables, b, n)["v"])
+    out = jitted({"t": t}, jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32))
+    assert sorted(rows_of(out, "a", "a2")) == [(2, 4), (3, 6)]
+
+
+def test_unknown_table_raises():
+    d = StringDictionary()
+    pc = PipelineCompiler(d)
+    with pytest.raises(EngineException, match="unknown table"):
+        pc.compile_transform("--DataXQuery--\nv = SELECT a FROM nope", {})
