@@ -89,6 +89,9 @@ class TableData:
     def capacity(self) -> int:
         return int(self.valid.shape[0])
 
+    def count(self) -> jnp.ndarray:
+        return jnp.sum(self.valid.astype(jnp.int32))
+
     def tree_flatten(self):
         names = tuple(sorted(self.cols))
         return tuple(self.cols[n] for n in names) + (self.valid,), names
@@ -775,6 +778,11 @@ class SelectCompiler:
 
             cols = {n: fn(group_env) for n, fn in flat_outputs}
             out_valid = jnp.arange(capacity) < num_groups
+            # groups beyond the static capacity are dropped; ride the
+            # drop count along as a hidden column so the runtime can
+            # emit it as an overflow metric (Output_<n>_GroupsDropped)
+            dropped = jnp.maximum(num_groups - capacity, 0).astype(jnp.int32)
+            cols["__overflow.groups"] = jnp.broadcast_to(dropped, (capacity,))
             return TableData(cols, out_valid)
 
         schema = ViewSchema(out_types, deferred)
