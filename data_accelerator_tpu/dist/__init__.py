@@ -1,0 +1,17 @@
+from .mesh import (
+    DATA_AXIS,
+    make_mesh,
+    replicated,
+    ring_sharding,
+    row_sharding,
+    step_shardings,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "make_mesh",
+    "replicated",
+    "ring_sharding",
+    "row_sharding",
+    "step_shardings",
+]

@@ -1,0 +1,82 @@
+"""Device mesh + sharding layout for the flow step.
+
+The reference scales by partitioning RDDs across Spark executors and
+letting Spark's shuffle service move rows for GROUP BY/JOIN
+(CommonProcessorFactory.scala:405-421; shuffle implicit in the
+``spark.sql`` calls at :257,271). TPU-native equivalent: one
+``jax.sharding.Mesh`` over the slice with a single ``data`` axis —
+
+- micro-batch rows shard over ``data`` (the executor-partition analog);
+- window ring buffers ``[slots, capacity]`` shard their *capacity* dim
+  over ``data`` so each chip retains only its shard of window history
+  (the sequence/context-parallel layout: long windows never materialize
+  on one chip);
+- reference/state tables replicate (they are small and join-broadcast,
+  like Spark broadcast joins);
+- aggregation outputs replicate — XLA GSPMD inserts the
+  all-gather/reduce-scatter collectives over ICI that replace Spark's
+  host shuffle.
+
+The whole step stays ONE jitted program: GSPMD partitions it from these
+in/out shardings, so sorts (group-by) lower to distributed sorts and
+segment reductions lower to psum-style collectives without any
+host-level communication code.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+    axis_name: str = DATA_AXIS,
+) -> Mesh:
+    """1-D mesh over the first ``n_devices`` local devices (all by
+    default). Multi-host: pass ``jax.devices()`` of the whole slice."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devs)} available"
+            )
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Rows of a [capacity] column shard over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def ring_sharding(mesh: Mesh) -> NamedSharding:
+    """Window ring cols are [slots, capacity]: shard capacity."""
+    return NamedSharding(mesh, P(None, DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def step_shardings(mesh: Mesh):
+    """(in_shardings, out_shardings) pytree prefixes for
+    ``FlowProcessor``'s step signature:
+
+    in:  (raw, ring, state, refdata, base_s, now_rel_ms, slot, delta_ms)
+    out: (datasets, new_ring, new_state, input_count, dataset_counts,
+          dropped_groups)
+    """
+    row = row_sharding(mesh)
+    ring = ring_sharding(mesh)
+    rep = replicated(mesh)
+    in_shardings = (row, ring, rep, rep, rep, rep, rep, rep)
+    out_shardings = (rep, ring, rep, rep, rep, rep)
+    return in_shardings, out_shardings

@@ -146,10 +146,12 @@ class FlowProcessor:
         udfs: Optional[dict] = None,
         batch_capacity: Optional[int] = None,
         output_datasets: Optional[List[str]] = None,
+        mesh=None,
     ):
         self.dict = dict_
         self.dictionary = dictionary or StringDictionary()
         self.udfs = udfs or {}
+        self.mesh = mesh
 
         input_conf = dict_.get_sub_dictionary(SettingNamespace.JobInputPrefix)
         process_conf = dict_.get_sub_dictionary(SettingNamespace.JobProcessPrefix)
@@ -169,6 +171,10 @@ class FlowProcessor:
                 "streaming.maxbatchsize", str(max(64, int(max_rate * self.interval_s)))
             )
         )
+        if self.mesh is not None:
+            # row shards must divide evenly over the data axis
+            n = self.mesh.size
+            self.batch_capacity = ((self.batch_capacity + n - 1) // n) * n
 
         self.timestamp_column = process_conf.get("timestampcolumn")
         self.watermark_s = process_conf.get_duration_option("watermark") or 0.0
@@ -357,10 +363,27 @@ class FlowProcessor:
             new_state = {n: out.get(n, state[n]) for n in state_names}
             input_count = projected.count()
             dataset_counts = {n: out[n].count() for n in output_datasets}
+            dropped_groups = {
+                n: out[n].cols["__overflow.groups"][0]
+                for n in output_datasets
+                if "__overflow.groups" in out[n].cols
+            }
             # plain tuple of pytrees for the jit boundary
-            return datasets, new_ring, new_state, input_count, dataset_counts
+            return (
+                datasets, new_ring, new_state, input_count, dataset_counts,
+                dropped_groups,
+            )
 
-        self._step = jax.jit(step)
+        self._step_fn = step
+        if self.mesh is not None:
+            from ..dist.mesh import step_shardings
+
+            in_shardings, out_shardings = step_shardings(self.mesh)
+            self._step = jax.jit(
+                step, in_shardings=in_shardings, out_shardings=out_shardings
+            )
+        else:
+            self._step = jax.jit(step)
 
     # -- per-batch host path ----------------------------------------------
     def encode_rows(self, rows: List[dict], base_ms: int) -> TableData:
@@ -382,6 +405,55 @@ class FlowProcessor:
             jnp.zeros((self.batch_capacity,), jnp.int32),
         )
         return TableData(cols, b.valid)
+
+    def encode_json_bytes(self, data: bytes, base_ms: int) -> TableData:
+        """Native ingest hot path: newline-delimited JSON bytes decoded by
+        the C++ decoder (native/decoder.cpp) straight into columnar
+        buffers — the from_json role at CommonProcessorFactory.scala:90-103
+        without any per-event Python objects. Falls back to the Python
+        row encoder if the native library is unavailable."""
+        from ..native import native_available
+
+        if not native_available():
+            import json as _json
+
+            rows = []
+            for ln in data.splitlines():
+                if not ln.strip():
+                    continue
+                try:
+                    rows.append(_json.loads(ln))
+                except ValueError:
+                    continue  # skip malformed lines like the native path
+                if len(rows) >= self.batch_capacity:
+                    break
+            return self.encode_rows(rows, base_ms)
+
+        if not hasattr(self, "_native_decoder") or self._native_decoder is None:
+            from ..native import NativeDecoder
+
+            self._native_decoder = NativeDecoder(self.input_schema, self.dictionary)
+        arrays, valid, rows, _consumed = self._native_decoder.decode(
+            data, self.batch_capacity
+        )
+        cap = self.batch_capacity
+        cols: Dict[str, jnp.ndarray] = {}
+        for col in self.input_schema.columns:
+            a = arrays[col.name]
+            if col.ctype == ColType.TIMESTAMP:
+                # slots the decoder left at 0 (field missing) stay at
+                # relative 0, matching the Python fallback encoder
+                a = np.where(a == 0, 0, a - np.int64(base_ms)).astype(np.int32)
+            elif col.ctype == ColType.BOOLEAN:
+                a = a.astype(np.bool_)
+            cols[col.name] = jnp.asarray(a)
+        for extra in (
+            ColumnName.RawPropertiesColumn,
+            ColumnName.RawSystemPropertiesColumn,
+        ):
+            if extra in self.raw_schema.types and extra not in cols:
+                cols[extra] = jnp.zeros((cap,), jnp.int32)
+        return TableData(cols, jnp.asarray(valid))
 
     def encode_columns(self, np_cols: Dict[str, np.ndarray], n: int) -> TableData:
         cap = self.batch_capacity
@@ -423,7 +495,10 @@ class FlowProcessor:
 
         ring = self.window_buffers.get("__ring")
         refdata_tables = {n: t for n, (_, t) in self.refdata.items()}
-        out_datasets, new_ring, new_state, input_count, dataset_counts = self._step(
+        (
+            out_datasets, new_ring, new_state, input_count, dataset_counts,
+            dropped_groups,
+        ) = self._step(
             raw, ring, self.state_data, refdata_tables,
             base_s, now_rel_ms, slot, jnp.asarray(delta_ms, jnp.int32),
         )
@@ -454,6 +529,8 @@ class FlowProcessor:
         }
         for n, c in dataset_counts.items():
             metrics[f"Output_{n}_Events_Count"] = float(int(c))
+        for n, c in dropped_groups.items():
+            metrics[f"Output_{n}_GroupsDropped"] = float(int(c))
         return datasets, metrics
 
     def commit(self) -> None:
