@@ -1,0 +1,3 @@
+from .decoder import NativeDecoder, native_available
+
+__all__ = ["NativeDecoder", "native_available"]

@@ -1,0 +1,198 @@
+"""ctypes binding for the native JSON->columnar ingest decoder.
+
+The C++ library (``native/decoder.cpp``) replaces the role Spark's
+executor-side ``from_json`` plays in the reference
+(CommonProcessorFactory.scala:90-103): every event's JSON parse happens
+in native code straight into numpy buffers. The shared library builds
+lazily with g++ on first use and is cached next to the source.
+
+The decoder owns a string dictionary (string -> int32) kept consistent
+with the Python ``StringDictionary`` by push-before/pull-after syncs
+around each decode call; both sides assign ids sequentially so ids
+stay stable across the boundary.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..core.schema import ColType, Schema, StringDictionary
+
+logger = logging.getLogger(__name__)
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "decoder.cpp",
+)
+_LIB_PATH = os.path.join(os.path.dirname(_SRC), "libdxdecoder.so")
+_build_lock = threading.Lock()
+_lib = None
+_lib_error: Optional[str] = None
+
+_CTYPE_NAME = {
+    ColType.LONG: "long",
+    ColType.DOUBLE: "double",
+    ColType.BOOLEAN: "boolean",
+    ColType.STRING: "string",
+    ColType.TIMESTAMP: "timestamp",
+}
+
+_NP_DTYPE = {
+    ColType.LONG: np.int32,
+    ColType.DOUBLE: np.float32,
+    ColType.BOOLEAN: np.uint8,
+    ColType.STRING: np.int32,
+    ColType.TIMESTAMP: np.int64,
+}
+
+
+def _build_library() -> Optional[str]:
+    if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(
+        _SRC
+    ):
+        return _LIB_PATH
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        "-o", _LIB_PATH, _SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (subprocess.SubprocessError, FileNotFoundError) as e:
+        logger.warning("native decoder build failed: %s", e)
+        return None
+    return _LIB_PATH
+
+
+def _load():
+    global _lib, _lib_error
+    if _lib is not None or _lib_error is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None or _lib_error is not None:
+            return _lib
+        path = _build_library()
+        if path is None:
+            _lib_error = "build failed"
+            return None
+        lib = ctypes.CDLL(path)
+        lib.dx_decoder_create.restype = ctypes.c_void_p
+        lib.dx_decoder_create.argtypes = [ctypes.c_char_p]
+        lib.dx_decoder_destroy.argtypes = [ctypes.c_void_p]
+        lib.dx_num_columns.restype = ctypes.c_int64
+        lib.dx_num_columns.argtypes = [ctypes.c_void_p]
+        lib.dx_decode.restype = ctypes.c_int64
+        lib.dx_decode.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.dx_dict_size.restype = ctypes.c_int64
+        lib.dx_dict_size.argtypes = [ctypes.c_void_p]
+        lib.dx_dict_push.restype = ctypes.c_int32
+        lib.dx_dict_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.dx_dict_get.restype = ctypes.c_int64
+        lib.dx_dict_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
+        ]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class NativeDecoder:
+    """Decode newline-delimited JSON event batches into columnar numpy
+    arrays typed by the flow's input schema."""
+
+    def __init__(self, schema: Schema, dictionary: StringDictionary):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native decoder unavailable (g++ build failed)")
+        self._lib = lib
+        self.schema = schema
+        self.dictionary = dictionary
+        desc = "".join(
+            f"{c.name}\t{_CTYPE_NAME[c.ctype]}\n" for c in schema.columns
+        )
+        self._d = lib.dx_decoder_create(desc.encode("utf-8"))
+        self._cols = list(schema.columns)
+        self._synced = 0
+        self._push_python_entries()
+
+    def close(self):
+        if self._d:
+            self._lib.dx_decoder_destroy(self._d)
+            self._d = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- dictionary sync --------------------------------------------------
+    def _push_python_entries(self):
+        """Push Python-side dictionary entries the native map hasn't seen
+        (ids are sequential on both sides, so push in id order)."""
+        native_n = self._lib.dx_dict_size(self._d)
+        py_n = len(self.dictionary)
+        for i in range(native_n, py_n):
+            s = self.dictionary.decode(i)
+            got = self._lib.dx_dict_push(self._d, (s or "").encode("utf-8"))
+            if got != i:
+                raise RuntimeError(
+                    f"dictionary desync: pushed {s!r} expecting id {i}, got {got}"
+                )
+        self._synced = py_n
+
+    def _pull_native_entries(self):
+        """Pull entries the native decode added into the Python dict."""
+        native_n = self._lib.dx_dict_size(self._d)
+        py_n = len(self.dictionary)
+        buf = ctypes.create_string_buffer(4096)
+        for i in range(py_n, native_n):
+            n = self._lib.dx_dict_get(self._d, i, buf, len(buf))
+            if n < 0:
+                raise RuntimeError(f"dictionary id {i} missing on native side")
+            if n >= len(buf):
+                bigger = ctypes.create_string_buffer(int(n) + 1)
+                self._lib.dx_dict_get(self._d, i, bigger, len(bigger))
+                s = bigger.value.decode("utf-8", "replace")
+            else:
+                s = buf.value.decode("utf-8", "replace")
+            got = self.dictionary.encode(s)
+            if got != i:
+                raise RuntimeError(
+                    f"dictionary desync pulling {s!r}: expected id {i}, got {got}"
+                )
+
+    # -- decode -----------------------------------------------------------
+    def decode(
+        self, data: bytes, max_rows: int
+    ) -> Tuple[Dict[str, np.ndarray], np.ndarray, int, int]:
+        """Returns (columns, valid, rows, bytes_consumed)."""
+        self._push_python_entries()
+        arrays: Dict[str, np.ndarray] = {}
+        ptrs = (ctypes.c_void_p * len(self._cols))()
+        for i, c in enumerate(self._cols):
+            a = np.zeros(max_rows, dtype=_NP_DTYPE[c.ctype])
+            arrays[c.name] = a
+            ptrs[i] = a.ctypes.data_as(ctypes.c_void_p)
+        valid = np.zeros(max_rows, dtype=np.uint8)
+        consumed = ctypes.c_int64(0)
+        rows = self._lib.dx_decode(
+            self._d, data, len(data), max_rows, ptrs,
+            valid.ctypes.data_as(ctypes.c_void_p), ctypes.byref(consumed),
+        )
+        self._pull_native_entries()
+        return arrays, valid.astype(bool), int(rows), int(consumed.value)

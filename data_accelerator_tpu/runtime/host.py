@@ -88,6 +88,12 @@ class StreamingHost:
             )
             raw = self.processor.encode_columns(cols, max_events)
             batch_time_ms = now_ms
+        elif hasattr(self.source, "poll_raw"):
+            # native ingest: raw JSON bytes -> C++ decoder -> device
+            blob, _n, consumed = self.source.poll_raw(max_events)
+            raw = self.processor.encode_json_bytes(
+                blob, (batch_time_ms // 1000) * 1000
+            )
         else:
             rows, consumed = self.source.poll(max_events)
             raw = self.processor.encode_rows(rows, (batch_time_ms // 1000) * 1000)
@@ -96,12 +102,18 @@ class StreamingHost:
             datasets, metrics = self.processor.process_batch(raw, batch_time_ms)
             self.dispatcher.dispatch(datasets, batch_time_ms)
             self.processor.commit()
+            self.source.ack()
         except Exception:
             logger.exception("batch processing failed; rethrowing for retry")
             raise
 
         metrics["Latency-Batch"] = (time.time() - t0) * 1000.0
         self.metric_logger.send_batch_metrics(metrics, batch_time_ms)
+        logger.info(
+            "batch %d: %s",
+            self.batches_processed + 1,
+            " ".join(f"{k}={v:.1f}" for k, v in sorted(metrics.items())),
+        )
 
         if self.checkpointer and (
             t0 - self._last_checkpoint >= self.checkpoint_interval_s

@@ -45,6 +45,10 @@ class StreamingSource:
     def poll(self, max_events: int) -> Tuple[List[dict], Offsets]:
         raise NotImplementedError
 
+    def ack(self) -> None:
+        """Batch fully processed + sunk: the source may release any
+        in-flight events retained for retry."""
+
     def close(self) -> None:
         pass
 
@@ -141,6 +145,13 @@ class FileSource(StreamingSource):
         self.name = name
         self.patterns = patterns
         self._consumed: set = set()
+        self._leftover: List[dict] = []
+        self._resume_skip = 0
+
+    def start(self, positions: Dict[Tuple[str, int], int]) -> None:
+        """Resume: the checkpointed offset is the count of fully-emitted
+        files in sorted order; skip that many on the first listing."""
+        self._resume_skip = positions.get((self.name, 0), 0)
 
     def list_files(self) -> List[str]:
         files: List[str] = []
@@ -149,15 +160,25 @@ class FileSource(StreamingSource):
         return sorted(set(files))
 
     def poll(self, max_events: int) -> Tuple[List[dict], Offsets]:
-        rows: List[dict] = []
+        """Rows beyond max_events carry over to the next poll — a file is
+        only offset-committed once fully emitted (at-least-once)."""
+        rows: List[dict] = self._leftover
+        self._leftover = []
+        if self._resume_skip and not self._consumed:
+            self._consumed.update(self.list_files()[: self._resume_skip])
+            self._resume_skip = 0
         n_before = len(self._consumed)
         for f in self.list_files():
             if f in self._consumed or len(rows) >= max_events:
                 continue
             self._consumed.add(f)
             rows.extend(read_json_file(f))
+        self._leftover = rows[max_events:]
+        committed = (
+            len(self._consumed) if not self._leftover else len(self._consumed) - 1
+        )
         return rows[:max_events], {
-            (self.name, 0): (n_before, len(self._consumed))
+            (self.name, 0): (n_before, committed)
         }
 
 
@@ -168,7 +189,9 @@ class SocketSource(StreamingSource):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, name: str = "socket"):
         self.name = name
-        self._buf: List[dict] = []
+        self._buf: List[bytes] = []
+        self._inflight: List[bytes] = []
+        self._inflight_seq = 0
         self._lock = threading.Lock()
         self._seq = 0
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -192,25 +215,52 @@ class SocketSource(StreamingSource):
 
     def _reader(self, conn):
         with conn:
-            f = conn.makefile("r", encoding="utf-8")
+            f = conn.makefile("rb")
             for line in f:
                 line = line.strip()
                 if not line:
                     continue
-                try:
-                    row = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
                 with self._lock:
-                    self._buf.append(row)
+                    self._buf.append(line)
+
+    def poll_raw(self, max_events: int) -> Tuple[bytes, int, Offsets]:
+        """Drain up to max_events raw JSON lines as one newline-joined
+        blob for the native decoder — no per-event Python parse.
+
+        Drained lines stay in an in-flight list until ``ack()`` so a
+        failed batch re-delivers them on the retry poll (at-least-once
+        within the process; cross-restart replay needs a replayable
+        upstream like the file/blob source)."""
+        with self._lock:
+            if self._inflight:
+                # previous batch not acked: re-deliver it first
+                lines = self._inflight[:max_events]
+                frm = self._inflight_seq
+            else:
+                lines = self._buf[:max_events]
+                self._buf = self._buf[max_events:]
+                self._inflight = lines
+                self._inflight_seq = self._seq
+                frm = self._seq
+                self._seq += len(lines)
+        blob = b"\n".join(lines) + (b"\n" if lines else b"")
+        return blob, len(lines), {(self.name, 0): (frm, frm + len(lines))}
+
+    def ack(self) -> None:
+        with self._lock:
+            self._inflight = []
 
     def poll(self, max_events: int) -> Tuple[List[dict], Offsets]:
-        with self._lock:
-            rows = self._buf[:max_events]
-            self._buf = self._buf[max_events:]
-        frm = self._seq
-        self._seq += len(rows)
-        return rows, {(self.name, 0): (frm, self._seq)}
+        blob, n, offsets = self.poll_raw(max_events)
+        rows = []
+        for line in blob.splitlines():
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+        return rows, offsets
 
     def close(self):
         self._closing = True
