@@ -27,3 +27,21 @@ Layer map (vs. reference layers, see SURVEY.md):
 """
 
 __version__ = "0.1.0"
+
+import os as _os
+
+# The TPU-tunnel sitecustomize pins jax.config's jax_platforms at
+# interpreter start, silently overriding the JAX_PLATFORMS env var. Make
+# the env var authoritative for this framework's processes (CLI hosts,
+# tests, bench drivers all select their platform via env).
+_env_platforms = _os.environ.get("JAX_PLATFORMS")
+if _env_platforms:
+    import jax as _jax
+
+    if (_jax.config.jax_platforms or "") != _env_platforms:
+        try:
+            _jax.config.update("jax_platforms", _env_platforms)
+        except RuntimeError:
+            pass  # backends already initialized; too late to switch
+
+del _os

@@ -290,8 +290,38 @@ def parse_conf_lines(
             # as present (the reference keeps the key with a null value;
             # features are switched purely by key presence)
             key, value = stripped, ""
-        out[key] = replace_tokens(value, replacements)
+        out[key] = replace_tokens(_unescape_value(value), replacements)
     return out
+
+
+def _unescape_value(value: str) -> str:
+    """java-properties-style escapes: multi-line values (projection steps,
+    inline snippets) are written as literal ``\\n`` in the flat .conf the
+    flattener produces; ``\\\\`` preserves literal backslashes (regexes,
+    Windows paths)."""
+    if "\\" not in value:
+        return value
+    out = []
+    i, n = 0, len(value)
+    while i < n:
+        ch = value[i]
+        if ch == "\\" and i + 1 < n:
+            nxt = value[i + 1]
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+            if nxt == "t":
+                out.append("\t")
+                i += 2
+                continue
+            if nxt == "\\":
+                out.append("\\")
+                i += 2
+                continue
+        out.append(ch)
+        i += 1
+    return "".join(out)
 
 
 def replace_tokens(src: Optional[str], tokens: Optional[Dict[str, str]]) -> Optional[str]:

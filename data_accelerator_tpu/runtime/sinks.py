@@ -238,9 +238,15 @@ class OutputDispatcher:
         results: Dict[str, int] = {}
         threads = []
         lock = threading.Lock()
+        errors: List[BaseException] = []
 
         def run_op(name: str, op: OutputOperator, rows: List[dict]):
-            counts = op.write(rows, batch_time_ms)
+            try:
+                counts = op.write(rows, batch_time_ms)
+            except BaseException as e:  # noqa: BLE001 — re-raised after join
+                with lock:
+                    errors.append(e)
+                return
             with lock:
                 for kind, c in counts.items():
                     results[f"{MetricName.MetricSinkPrefix}{kind}"] = (
@@ -254,6 +260,10 @@ class OutputDispatcher:
             threads.append(t)
         for t in threads:
             t.join()
+        if errors:
+            # propagate so the host's batch try/except retries the batch
+            # instead of checkpointing past lost events (at-least-once)
+            raise errors[0]
         for metric, count in results.items():
             self.metric_logger.send_metric(metric, count, batch_time_ms)
         return results

@@ -317,3 +317,36 @@ def test_unknown_table_raises():
     pc = PipelineCompiler(d)
     with pytest.raises(EngineException, match="unknown table"):
         pc.compile_transform("--DataXQuery--\nv = SELECT a FROM nope", {})
+
+
+def test_group_capacity_overflow_metric():
+    """Groups beyond max_group_capacity drop, but the drop count rides a
+    hidden column so the runtime can surface Output_*_GroupsDropped."""
+    import jax.numpy as jnp
+
+    from data_accelerator_tpu.compile.planner import (
+        PlannerConfig,
+        SelectCompiler,
+        TableData,
+        ViewSchema,
+    )
+    from data_accelerator_tpu.compile.sqlparser import parse_select
+    from data_accelerator_tpu.core.schema import StringDictionary
+
+    cap = 32
+    schema = ViewSchema({"k": "long", "v": "double"})
+    sc = SelectCompiler(
+        {"T": schema}, {"T": cap}, StringDictionary(),
+        config=PlannerConfig(max_group_capacity=8),
+    )
+    view = sc.compile_select(
+        "G", parse_select("SELECT k, COUNT(*) AS c FROM T GROUP BY k")
+    )
+    t = TableData(
+        {"k": jnp.arange(cap, dtype=jnp.int32),
+         "v": jnp.ones(cap, jnp.float32)},
+        jnp.ones(cap, jnp.bool_),
+    )
+    out = view.fn({"T": t}, jnp.int32(0), jnp.int32(0))
+    assert int(out.count()) == 8  # capacity-bounded
+    assert int(out.cols["__overflow.groups"][0]) == 32 - 8
