@@ -1,0 +1,113 @@
+"""Headline benchmark: SimulatedData IoT alerting flow throughput.
+
+Measures sustained events/sec/chip through the full per-batch path —
+vectorized ingest encode, device step (projection → threshold rule →
+5 s-window group-by), output materialization, metric computation — on
+whatever platform JAX selects (the driver runs it on one real TPU chip).
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+Baseline: the reference publishes no numbers (BASELINE.md), so
+vs_baseline is measured against the north-star target's per-chip share:
+1M events/sec on a v5e-16 => 62,500 events/sec/chip.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+PER_CHIP_TARGET = 1_000_000 / 16.0  # north-star share per chip
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def build_processor(capacity):
+    from __graft_entry__ import _build
+
+    return _build(batch_capacity=capacity)
+
+
+def make_raw(proc, alert_rate=0.01, seed=3):
+    """Realistic alerting distribution: ~1% of events trip the rule."""
+    import jax.numpy as jnp
+
+    from data_accelerator_tpu.compile.planner import TableData
+
+    cap = proc.batch_capacity
+    rng = np.random.RandomState(seed)
+    dd = proc.dictionary
+    type_ids = np.array(
+        [dd.encode("Heating"), dd.encode("WindSpeed"), dd.encode("DoorLock")],
+        np.int32,
+    )
+    is_door = rng.uniform(size=cap) < 2 * alert_rate
+    dtype_col = np.where(
+        is_door, type_ids[2], type_ids[rng.randint(0, 2, cap)]
+    ).astype(np.int32)
+    status = np.where(
+        is_door & (rng.uniform(size=cap) < 0.5), 0, 1
+    ).astype(np.int32)
+    cols = {}
+    for c, t in proc.raw_schema.types.items():
+        if c.endswith("deviceType"):
+            cols[c] = jnp.asarray(dtype_col)
+        elif c.endswith("status"):
+            cols[c] = jnp.asarray(status)
+        elif c.endswith("deviceId"):
+            cols[c] = jnp.asarray(rng.randint(1, 9, cap).astype(np.int32))
+        elif c.endswith("homeId"):
+            cols[c] = jnp.asarray(
+                np.full(cap, 150, np.int32)
+            )
+        elif t == "double":
+            cols[c] = jnp.asarray(rng.uniform(0, 100, cap).astype(np.float32))
+        else:
+            cols[c] = jnp.asarray(np.zeros(cap, np.int32))
+    return TableData(cols, jnp.ones((cap,), jnp.bool_))
+
+
+def main():
+    import jax
+
+    backend = jax.default_backend()
+    capacity = int(os.environ.get(
+        "BENCH_CAPACITY", "131072" if backend != "cpu" else "65536"
+    ))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+
+    proc = build_processor(capacity)
+    raw = make_raw(proc)
+
+    base_ms = 1_700_000_000_000
+    for i in range(warmup):
+        proc.process_batch(raw, batch_time_ms=base_ms + i * 1000)
+
+    lat_ms = []
+    t_start = time.perf_counter()
+    for i in range(iters):
+        t0 = time.perf_counter()
+        proc.process_batch(raw, batch_time_ms=base_ms + (warmup + i) * 1000)
+        lat_ms.append((time.perf_counter() - t0) * 1000.0)
+    total_s = time.perf_counter() - t_start
+
+    events = capacity * iters
+    eps = events / total_s
+    p99 = float(np.percentile(lat_ms, 99))
+    print(json.dumps({
+        "metric": "iot_alerting_events_per_sec_per_chip",
+        "value": round(eps, 1),
+        "unit": "events/s",
+        "vs_baseline": round(eps / PER_CHIP_TARGET, 3),
+        "p99_batch_ms": round(p99, 2),
+        "backend": backend,
+        "batch_capacity": capacity,
+    }))
+
+
+if __name__ == "__main__":
+    main()
