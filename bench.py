@@ -33,10 +33,6 @@ def build_processor(capacity):
 
 def make_raw(proc, alert_rate=0.01, seed=3):
     """Realistic alerting distribution: ~1% of events trip the rule."""
-    import jax.numpy as jnp
-
-    from data_accelerator_tpu.compile.planner import TableData
-
     cap = proc.batch_capacity
     rng = np.random.RandomState(seed)
     dd = proc.dictionary
@@ -54,20 +50,16 @@ def make_raw(proc, alert_rate=0.01, seed=3):
     cols = {}
     for c, t in proc.raw_schema.types.items():
         if c.endswith("deviceType"):
-            cols[c] = jnp.asarray(dtype_col)
+            cols[c] = dtype_col
         elif c.endswith("status"):
-            cols[c] = jnp.asarray(status)
+            cols[c] = status
         elif c.endswith("deviceId"):
-            cols[c] = jnp.asarray(rng.randint(1, 9, cap).astype(np.int32))
+            cols[c] = rng.randint(1, 9, cap).astype(np.int32)
         elif c.endswith("homeId"):
-            cols[c] = jnp.asarray(
-                np.full(cap, 150, np.int32)
-            )
+            cols[c] = np.full(cap, 150, np.int32)
         elif t == "double":
-            cols[c] = jnp.asarray(rng.uniform(0, 100, cap).astype(np.float32))
-        else:
-            cols[c] = jnp.asarray(np.zeros(cap, np.int32))
-    return TableData(cols, jnp.ones((cap,), jnp.bool_))
+            cols[c] = rng.uniform(0, 100, cap).astype(np.float32)
+    return proc.encode_columns(cols, cap)
 
 
 def main():

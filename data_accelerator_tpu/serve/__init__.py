@@ -1,0 +1,21 @@
+"""Control plane: flow lifecycle, config generation, job management.
+
+The TPU-native analog of the reference's Services/ layer
+(DataX.Config + DataX.Flow.* + DataX.Gateway): design-time flow
+documents in, runnable flat ``datax.job.*`` confs and managed engine
+jobs out.
+"""
+
+from .templating import TokenDictionary
+from .storage import LocalDesignTimeStorage, LocalRuntimeStorage
+from .flowbuilder import FlowConfigBuilder, RuleDefinitionGenerator
+from .generation import RuntimeConfigGeneration
+
+__all__ = [
+    "TokenDictionary",
+    "LocalDesignTimeStorage",
+    "LocalRuntimeStorage",
+    "FlowConfigBuilder",
+    "RuleDefinitionGenerator",
+    "RuntimeConfigGeneration",
+]

@@ -1,0 +1,175 @@
+"""Design-time and runtime config storage.
+
+reference: DataX.Config/Storage/{IDesignTimeConfigStorage,
+IRuntimeConfigStorage}.cs with the CosmosDB implementation for flow
+documents and blob storage for runtime files; the local ("one-box")
+implementations are DataX.Config.Local/{LocalDesignTimeStorage,
+LocalRuntimeTimeStorage}.cs. Here the local filesystem is the primary
+backend (TPU VMs mount shared storage); the interfaces keep the same
+split so an object-store backend can slot in.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+from typing import Dict, List, Optional
+
+
+class DesignTimeStorage:
+    """Flow documents keyed by flow name."""
+
+    def get_by_name(self, name: str) -> Optional[dict]:
+        raise NotImplementedError
+
+    def get_all(self) -> List[dict]:
+        raise NotImplementedError
+
+    def save(self, doc: dict) -> dict:
+        raise NotImplementedError
+
+    def delete(self, name: str) -> bool:
+        raise NotImplementedError
+
+
+class LocalDesignTimeStorage(DesignTimeStorage):
+    """One JSON file per flow under ``root/`` (diskdb analog)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _path(self, name: str) -> str:
+        safe = "".join(c for c in name if c.isalnum() or c in "-_.")
+        return os.path.join(self.root, f"{safe}.json")
+
+    def get_by_name(self, name: str) -> Optional[dict]:
+        p = self._path(name)
+        if not os.path.exists(p):
+            return None
+        with open(p, "r", encoding="utf-8") as f:
+            return json.load(f)
+
+    def get_all(self) -> List[dict]:
+        out = []
+        for fn in sorted(os.listdir(self.root)):
+            if fn.endswith(".json"):
+                with open(os.path.join(self.root, fn), encoding="utf-8") as f:
+                    out.append(json.load(f))
+        return out
+
+    def save(self, doc: dict) -> dict:
+        name = doc.get("name")
+        if not name:
+            raise ValueError("flow document requires a 'name'")
+        with self._lock:
+            tmp = self._path(name) + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, self._path(name))
+        return doc
+
+    def delete(self, name: str) -> bool:
+        p = self._path(name)
+        if os.path.exists(p):
+            os.remove(p)
+            return True
+        return False
+
+
+class RuntimeStorage:
+    """Generated runtime artifacts (conf, transform, projection, schema)."""
+
+    def save_file(self, path: str, content: str) -> str:
+        raise NotImplementedError
+
+    def read_file(self, path: str) -> str:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def delete_all(self, prefix: str) -> None:
+        raise NotImplementedError
+
+
+class LocalRuntimeStorage(RuntimeStorage):
+    """Runtime files under a root dir; atomic temp+rename writes
+    (reference: HadoopClient.scala:391-441 write semantics)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def resolve(self, path: str) -> str:
+        return path if os.path.isabs(path) else os.path.join(self.root, path)
+
+    def save_file(self, path: str, content: str) -> str:
+        full = self.resolve(path)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        tmp = full + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(content)
+        os.replace(tmp, full)
+        return full
+
+    def read_file(self, path: str) -> str:
+        with open(self.resolve(path), encoding="utf-8") as f:
+            return f.read()
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(self.resolve(path))
+
+    def delete_all(self, prefix: str) -> None:
+        full = self.resolve(prefix)
+        if os.path.isdir(full):
+            shutil.rmtree(full, ignore_errors=True)
+        elif os.path.exists(full):
+            os.remove(full)
+
+
+class JobRegistry:
+    """Job records (name -> record dict), stored alongside runtime configs.
+
+    reference: DataX.Config SparkJobData/SparkJobConfig docs in the
+    design-time store, upserted by S800_DeploySparkJob.cs:23-60.
+    """
+
+    def __init__(self, storage: LocalRuntimeStorage):
+        self.storage = storage
+        self._lock = threading.Lock()
+
+    def _path(self, name: str) -> str:
+        return os.path.join("jobs", f"{name}.json")
+
+    def upsert(self, record: dict) -> dict:
+        name = record["name"]
+        with self._lock:
+            existing = self.get(name) or {}
+            existing.update(record)
+            self.storage.save_file(self._path(name), json.dumps(existing, indent=1))
+        return existing
+
+    def get(self, name: str) -> Optional[dict]:
+        if not self.storage.exists(self._path(name)):
+            return None
+        return json.loads(self.storage.read_file(self._path(name)))
+
+    def get_all(self) -> List[dict]:
+        jobs_dir = self.storage.resolve("jobs")
+        if not os.path.isdir(jobs_dir):
+            return []
+        out = []
+        for fn in sorted(os.listdir(jobs_dir)):
+            if fn.endswith(".json"):
+                out.append(json.loads(self.storage.read_file(
+                    os.path.join("jobs", fn))))
+        return out
+
+    def delete(self, name: str) -> None:
+        p = self.storage.resolve(self._path(name))
+        if os.path.exists(p):
+            os.remove(p)

@@ -1,0 +1,289 @@
+"""Control-plane tests: templating, flow building, and the S100–S900
+runtime config generation chain, modeled on the reference's
+DataX.Config.Test suite (RuntimeConfigGenerationTest.cs golden flow ->
+conf runs against local storage fakes) and
+DataX.Config.Local.Test/LocalTests.cs (generate then actually run)."""
+
+import json
+import os
+
+import pytest
+
+from data_accelerator_tpu.serve.templating import TokenDictionary, unresolved_tokens
+from data_accelerator_tpu.serve.flowbuilder import (
+    FlowConfigBuilder,
+    RuleDefinitionGenerator,
+)
+from data_accelerator_tpu.serve.storage import (
+    JobRegistry,
+    LocalDesignTimeStorage,
+    LocalRuntimeStorage,
+)
+from data_accelerator_tpu.serve.generation import RuntimeConfigGeneration
+
+INPUT_SCHEMA = json.dumps({
+    "type": "struct",
+    "fields": [
+        {"name": "deviceDetails", "type": {"type": "struct", "fields": [
+            {"name": "deviceId", "type": "long", "nullable": False,
+             "metadata": {"allowedValues": [1, 2, 3]}},
+            {"name": "deviceType", "type": "string", "nullable": False,
+             "metadata": {"allowedValues": ["DoorLock", "Heating"]}},
+            {"name": "status", "type": "long", "nullable": False,
+             "metadata": {"allowedValues": [0, 1]}},
+        ]}, "nullable": False, "metadata": {}},
+    ],
+})
+
+
+def make_gui(name="GenTestFlow"):
+    """Designer state equivalent to the reference's HomeAutomationLocal
+    sample (DeploymentLocal/sample/HomeAutomationLocal.json gui section)."""
+    return {
+        "name": name,
+        "displayName": name,
+        "input": {
+            "mode": "streaming",
+            "type": "local",
+            "properties": {
+                "windowDuration": "1",
+                "maxRate": "100",
+                "inputSchemaFile": INPUT_SCHEMA,
+                "normalizationSnippet": (
+                    "current_timestamp() AS eventTimeStamp\nRaw.*"
+                ),
+                "watermarkValue": 0,
+                "watermarkUnit": "second",
+            },
+            "referenceData": [],
+        },
+        "process": {
+            "timestampColumn": "eventTimeStamp",
+            "watermark": "0 second",
+            "functions": [],
+            "queries": [
+                "--DataXQuery--\n"
+                "DoorEvents = SELECT deviceDetails.deviceId, "
+                "deviceDetails.deviceType, deviceDetails.status, eventTimeStamp "
+                "FROM DataXProcessedInput;\n"
+                "--DataXQuery--\n"
+                "DoorOpenCount = SELECT deviceId, COUNT(*) AS Cnt "
+                "FROM DoorEvents WHERE status = 0 GROUP BY deviceId;\n"
+                "OUTPUT DoorOpenCount TO Metrics;"
+            ],
+            "jobconfig": {"jobNumChips": "1", "jobBatchCapacity": "4096"},
+        },
+        "outputs": [{"id": "Metrics", "type": "metric", "properties": {}}],
+        "outputTemplates": [],
+        "rules": [
+            {
+                "id": "DoorLock Open",
+                "type": "tag",
+                "properties": {
+                    "_S_ruleType": "SimpleRule",
+                    "_S_ruleDescription": "DoorLock Open",
+                    "_S_severity": "Critical",
+                    "_S_tagName": "Tag",
+                    "_S_tag": "OPEN",
+                    "_S_isAlert": True,
+                    "_S_alertSinks": ["Metrics"],
+                    "schemaTableName": "DataXProcessedInput",
+                    "conditions": {
+                        "type": "group",
+                        "conjunction": "and",
+                        "conditions": [
+                            {"type": "condition", "conjunction": "and",
+                             "field": "deviceDetails.deviceType",
+                             "operator": "stringEqual", "value": "DoorLock"},
+                            {"type": "condition", "conjunction": "and",
+                             "field": "deviceDetails.status",
+                             "operator": "equal", "value": "0"},
+                        ],
+                    },
+                },
+            }
+        ],
+    }
+
+
+# ---------------------------------------------------------------------------
+# templating
+# ---------------------------------------------------------------------------
+class TestTemplating:
+    def test_plain_and_secret_tokens(self):
+        t = TokenDictionary({"name": "Flow1", "base": "/data"})
+        assert t.replace("${base}/${name}") == "/data/Flow1"
+        assert t.replace("_S_{name}") == "Flow1"
+
+    def test_whole_string_json_value(self):
+        t = TokenDictionary({"windows": [{"name": "w", "windowDuration": "5 s"}]})
+        out = t.replace({"timeWindows": "_S_{windows}"})
+        assert out["timeWindows"] == [{"name": "w", "windowDuration": "5 s"}]
+
+    def test_fixed_point_nesting(self):
+        t = TokenDictionary({"a": "${b}/x", "b": "base"})
+        assert t.replace("${a}") == "base/x"
+
+    def test_unknown_token_survives(self):
+        t = TokenDictionary({})
+        assert t.replace("_S_{missing}") == "_S_{missing}"
+        assert unresolved_tokens({"k": "_S_{missing}"}) == ["missing"]
+
+
+# ---------------------------------------------------------------------------
+# flow builder + rule definitions
+# ---------------------------------------------------------------------------
+class TestFlowBuilder:
+    def test_build_wraps_gui_with_template(self):
+        doc = FlowConfigBuilder().build(make_gui())
+        assert doc["name"] == "GenTestFlow"
+        assert "template" in doc["commonProcessor"]
+        assert doc["commonProcessor"]["template"]["process"]["transform"] == (
+            "_S_{processTransforms}"
+        )
+
+    def test_existing_doc_preserved(self):
+        doc = FlowConfigBuilder().build(make_gui())
+        doc["commonProcessor"]["jobCommonTokens"]["custom"] = "x"
+        doc2 = FlowConfigBuilder().build(make_gui(), existing=doc)
+        assert doc2["commonProcessor"]["jobCommonTokens"]["custom"] == "x"
+
+    def test_rule_definitions_from_conditions_tree(self):
+        defs = json.loads(
+            RuleDefinitionGenerator().generate(make_gui()["rules"], "prod1")
+        )
+        assert len(defs) == 1
+        d = defs[0]
+        assert d["$ruleType"] == "SimpleRule"
+        assert d["$productId"] == "prod1"
+        assert d["$tagname"] == "Tag"
+        assert d["$alertsinks"] == ["Metrics"]
+        assert d["$condition"] == (
+            "deviceDetails.deviceType = 'DoorLock' AND deviceDetails.status = 0"
+        )
+
+    def test_aggregate_rule_condition(self):
+        rules = [{
+            "id": "hot", "type": "tag",
+            "properties": {
+                "_S_ruleType": "AggregateRule",
+                "_S_pivots": ["deviceId"],
+                "schemaTableName": "DataXProcessedInput",
+                "conditions": {
+                    "type": "group", "conjunction": "and",
+                    "conditions": [
+                        {"type": "condition", "aggregate": "AVG",
+                         "field": "temperature", "operator": "greaterThan",
+                         "value": "90"},
+                    ],
+                },
+            },
+        }]
+        d = json.loads(RuleDefinitionGenerator().generate(rules, "p"))[0]
+        assert d["$aggs"] == ["AVG(temperature)"]
+        assert d["$condition"] == "AVG(temperature) > 90"
+
+
+# ---------------------------------------------------------------------------
+# generation chain
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def stores(tmp_path):
+    design = LocalDesignTimeStorage(str(tmp_path / "design"))
+    runtime = LocalRuntimeStorage(str(tmp_path / "runtime"))
+    return design, runtime
+
+
+class TestGeneration:
+    def test_generate_writes_conf_and_files(self, stores):
+        design, runtime = stores
+        design.save(FlowConfigBuilder().build(make_gui()))
+        gen = RuntimeConfigGeneration(design, runtime)
+        res = gen.generate("GenTestFlow")
+        assert res.ok, res.errors
+        assert res.job_names == ["DataXTpu-GenTestFlow"]
+        conf_path = res.conf_paths[0]
+        assert os.path.exists(conf_path)
+        conf = dict(
+            line.split("=", 1)
+            for line in open(conf_path).read().splitlines()
+            if "=" in line
+        )
+        assert conf["datax.job.name"] == "GenTestFlow"
+        assert conf["datax.job.input.default.inputtype"] == "local"
+        assert conf["datax.job.input.default.streaming.intervalinseconds"] == "1"
+        assert conf["datax.job.process.timestampcolumn"] == "eventTimeStamp"
+        assert conf["datax.job.process.batchcapacity"] == "4096"
+        # transform file written and referenced
+        tpath = conf["datax.job.process.transform"]
+        assert os.path.exists(tpath)
+        transform = open(tpath).read()
+        assert "DoorOpenCount" in transform
+        assert "OPENAlert" in transform  # rule expanded by codegen
+        # outputs: DoorOpenCount routed to metric sink
+        assert conf["datax.job.output.DoorOpenCount.metric"] == "enabled"
+        # job record upserted
+        job = gen.jobs.get("DataXTpu-GenTestFlow")
+        assert job["flow"] == "GenTestFlow"
+        assert job["confPath"] == conf_path
+
+    def test_metrics_config_attached(self, stores):
+        design, runtime = stores
+        design.save(FlowConfigBuilder().build(make_gui()))
+        res = RuntimeConfigGeneration(design, runtime).generate("GenTestFlow")
+        assert res.ok, res.errors
+        doc = design.get_by_name("GenTestFlow")
+        assert doc["jobNames"] == ["DataXTpu-GenTestFlow"]
+        assert doc.get("metrics"), "metrics dashboard config not generated"
+
+    def test_generate_missing_flow(self, stores):
+        design, runtime = stores
+        res = RuntimeConfigGeneration(design, runtime).generate("NoSuchFlow")
+        assert not res.ok
+
+    def test_generated_conf_runs_one_box(self, stores):
+        """The LocalTests.cs analog: generated conf drives the real
+        engine for a few batches."""
+        design, runtime = stores
+        design.save(FlowConfigBuilder().build(make_gui()))
+        res = RuntimeConfigGeneration(design, runtime).generate("GenTestFlow")
+        assert res.ok, res.errors
+
+        from data_accelerator_tpu.core.config import (
+            SettingDictionary,
+            parse_conf_lines,
+        )
+        from data_accelerator_tpu.obs.metrics import MetricLogger
+        from data_accelerator_tpu.obs.store import MetricStore
+        from data_accelerator_tpu.runtime.host import StreamingHost
+        from data_accelerator_tpu.runtime.sinks import (
+            OutputDispatcher,
+            build_output_operators,
+        )
+
+        conf = SettingDictionary(
+            parse_conf_lines(open(res.conf_paths[0]).read().splitlines())
+        )
+        store = MetricStore()
+        host = StreamingHost(conf)
+        host.metric_logger = MetricLogger("DATAX-GenTestFlow", store=store)
+        table_sink_map = {"DoorOpenCount": ["DoorOpenCount"],
+                         "OPENAlert": ["OPENAlert"]}
+        ops = build_output_operators(conf, host.metric_logger, table_sink_map)
+        host.dispatcher = OutputDispatcher(ops, host.metric_logger)
+        host.run(max_batches=2)
+        assert host.batches_processed == 2
+        input_key = "DATAX-GenTestFlow:Input_DataXProcessedInput_Events_Count"
+        assert len(store.points(input_key)) == 2
+
+
+class TestJobRegistry:
+    def test_upsert_get_delete(self, stores):
+        _, runtime = stores
+        reg = JobRegistry(runtime)
+        reg.upsert({"name": "j1", "state": "idle"})
+        reg.upsert({"name": "j1", "state": "running"})
+        assert reg.get("j1")["state"] == "running"
+        assert [j["name"] for j in reg.get_all()] == ["j1"]
+        reg.delete("j1")
+        assert reg.get("j1") is None
