@@ -7,15 +7,36 @@ jobs out.
 """
 
 from .templating import TokenDictionary
-from .storage import LocalDesignTimeStorage, LocalRuntimeStorage
+from .storage import JobRegistry, LocalDesignTimeStorage, LocalRuntimeStorage
 from .flowbuilder import FlowConfigBuilder, RuleDefinitionGenerator
 from .generation import RuntimeConfigGeneration
+from .jobs import JobOperation, JobState, LocalJobClient, TpuJobClient
+from .flowservice import FlowOperation
+from .schemainference import SchemaInferenceManager, infer_schema
+from .sqlanalyzer import SqlAnalyzer
+from .livequery import KernelService
+from .scenario import Scenario, ScenarioContext
+from .restapi import DataXApi, DataXApiService
 
 __all__ = [
     "TokenDictionary",
+    "JobRegistry",
     "LocalDesignTimeStorage",
     "LocalRuntimeStorage",
     "FlowConfigBuilder",
     "RuleDefinitionGenerator",
     "RuntimeConfigGeneration",
+    "JobOperation",
+    "JobState",
+    "LocalJobClient",
+    "TpuJobClient",
+    "FlowOperation",
+    "SchemaInferenceManager",
+    "infer_schema",
+    "SqlAnalyzer",
+    "KernelService",
+    "Scenario",
+    "ScenarioContext",
+    "DataXApi",
+    "DataXApiService",
 ]

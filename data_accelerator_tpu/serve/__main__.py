@@ -1,0 +1,43 @@
+"""Run the control-plane service: ``python -m data_accelerator_tpu.serve``.
+
+Args (key=value): port=5000 root=/tmp/dxtpu-serve roles=false
+
+The one-box analog of the reference's Flow.ManagementService container
+entry (DeploymentLocal/finalrun.sh): all four flow services + gateway
+role gate in one process, local file storage under ``root``.
+"""
+
+import logging
+import sys
+
+from .flowservice import FlowOperation
+from .restapi import DataXApi, DataXApiService
+from .storage import LocalDesignTimeStorage, LocalRuntimeStorage
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    args = dict(
+        a.split("=", 1) for a in (argv or sys.argv[1:]) if "=" in a
+    )
+    root = args.get("root", "/tmp/dxtpu-serve")
+    port = int(args.get("port", "5000"))
+    flow_ops = FlowOperation(
+        LocalDesignTimeStorage(f"{root}/design"),
+        LocalRuntimeStorage(f"{root}/runtime"),
+    )
+    api = DataXApi(
+        flow_ops, require_roles=args.get("roles", "false") == "true"
+    )
+    service = DataXApiService(api, port=port)
+    logging.getLogger(__name__).info(
+        "control plane on :%d (storage %s)", service.port, root
+    )
+    try:
+        service.serve_forever()
+    except KeyboardInterrupt:
+        service.stop()
+
+
+if __name__ == "__main__":
+    main()

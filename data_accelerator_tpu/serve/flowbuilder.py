@@ -116,6 +116,17 @@ class FlowConfigBuilder:
 # gui rules -> rule-definition JSON for the codegen engine
 # ---------------------------------------------------------------------------
 
+def _q(v) -> str:
+    """SQL single-quoted literal with quote doubling — designer values
+    like O'Brien must not break (or splice into) the generated SQL."""
+    return "'" + str(v).replace("'", "''") + "'"
+
+
+def _lk(v) -> str:
+    """LIKE pattern body, quote-escaped (wildcards added by caller)."""
+    return str(v).replace("'", "''")
+
+
 # gui condition operator -> SQL fragment builder. The gui's no-code rule
 # tree (datax-pipeline rule builder) emits these operator names.
 _OPERATORS = {
@@ -125,12 +136,12 @@ _OPERATORS = {
     "lessThan": lambda f, v: f"{f} < {v}",
     "greaterThanOrEqual": lambda f, v: f"{f} >= {v}",
     "lessThanOrEqual": lambda f, v: f"{f} <= {v}",
-    "stringEqual": lambda f, v: f"{f} = '{v}'",
-    "stringNotEqual": lambda f, v: f"{f} != '{v}'",
-    "contains": lambda f, v: f"{f} LIKE '%{v}%'",
-    "notContains": lambda f, v: f"{f} NOT LIKE '%{v}%'",
-    "startsWith": lambda f, v: f"{f} LIKE '{v}%'",
-    "endsWith": lambda f, v: f"{f} LIKE '%{v}'",
+    "stringEqual": lambda f, v: f"{f} = {_q(v)}",
+    "stringNotEqual": lambda f, v: f"{f} != {_q(v)}",
+    "contains": lambda f, v: f"{f} LIKE '%{_lk(v)}%'",
+    "notContains": lambda f, v: f"{f} NOT LIKE '%{_lk(v)}%'",
+    "startsWith": lambda f, v: f"{f} LIKE '{_lk(v)}%'",
+    "endsWith": lambda f, v: f"{f} LIKE '%{_lk(v)}'",
     "isNull": lambda f, v: f"{f} IS NULL",
     "isNotNull": lambda f, v: f"{f} IS NOT NULL",
 }
@@ -141,17 +152,17 @@ def _condition_sql(node: dict, aggregate_mode: bool) -> str:
     if not node:
         return ""
     if node.get("type") == "group":
-        parts = [
-            _condition_sql(c, aggregate_mode)
+        # keep (child, sql) pairs aligned so each child's conjunction
+        # joins its own fragment even when siblings produce no SQL
+        rendered = [
+            (c, _condition_sql(c, aggregate_mode))
             for c in node.get("conditions") or []
         ]
-        parts = [p for p in parts if p]
-        if not parts:
+        rendered = [(c, sql) for c, sql in rendered if sql]
+        if not rendered:
             return ""
         joined = []
-        for i, (child, sql) in enumerate(
-            zip(node.get("conditions") or [], parts)
-        ):
+        for i, (child, sql) in enumerate(rendered):
             if i > 0:
                 joined.append((child.get("conjunction") or "and").upper())
             joined.append(f"({sql})" if child.get("type") == "group" else sql)

@@ -1,0 +1,119 @@
+"""Flow lifecycle public service: save, generate, start/stop/restart.
+
+reference: DataX.Config/PublicService/{FlowOperation,JobOperation}.cs —
+``SaveFlowConfig`` (FlowOperation.cs:112) builds/merges the flow doc and
+upserts design-time storage; ``GenerateConfigs`` runs the S100–S900
+chain; ``StartJobsForFlow``/``StopJobsForFlow``/``RestartJobsForFlow``
+(FlowOperation.cs:158+) fan out to SparkJobOperation per job name;
+``ScheduleBatch`` (FlowOperation.cs:88) registers batch rounds. The
+DeleteHelper cascade (DataX.Flow.DeleteHelper) is ``delete_flow``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from .flowbuilder import FlowConfigBuilder
+from .generation import GenerationResult, RuntimeConfigGeneration
+from .jobs import JobOperation, JobState, LocalJobClient, TpuJobClient
+from .storage import DesignTimeStorage, JobRegistry, LocalRuntimeStorage
+
+logger = logging.getLogger(__name__)
+
+
+class FlowOperation:
+    """The control plane's front door (one per service process)."""
+
+    def __init__(
+        self,
+        design_storage: DesignTimeStorage,
+        runtime_storage: LocalRuntimeStorage,
+        job_client: Optional[TpuJobClient] = None,
+    ):
+        self.design = design_storage
+        self.runtime = runtime_storage
+        self.builder = FlowConfigBuilder()
+        self.generation = RuntimeConfigGeneration(design_storage, runtime_storage)
+        self.registry: JobRegistry = self.generation.jobs
+        self.jobs = JobOperation(
+            self.registry,
+            job_client or LocalJobClient(log_dir=runtime_storage.resolve("logs")),
+        )
+
+    # -- design-time -----------------------------------------------------
+    def save_flow(self, gui: dict) -> dict:
+        """reference: FlowOperation.SaveFlowConfig (FlowOperation.cs:112)."""
+        name = gui.get("name")
+        existing = self.design.get_by_name(name) if name else None
+        doc = self.builder.build(gui, existing=existing)
+        return self.design.save(doc)
+
+    def get_flow(self, name: str) -> Optional[dict]:
+        return self.design.get_by_name(name)
+
+    def get_all_flows(self) -> List[dict]:
+        return self.design.get_all()
+
+    def generate_configs(self, flow_name: str) -> GenerationResult:
+        return self.generation.generate(flow_name)
+
+    # -- runtime ---------------------------------------------------------
+    def _flow_job_names(self, flow_name: str) -> List[str]:
+        doc = self.design.get_by_name(flow_name)
+        if not doc:
+            raise KeyError(f"flow '{flow_name}' not found")
+        names = doc.get("jobNames") or []
+        if not names:
+            raise ValueError(
+                f"flow '{flow_name}' has no generated jobs; run generateconfigs"
+            )
+        return names
+
+    def start_jobs(self, flow_name: str, batches: Optional[int] = None) -> List[dict]:
+        return [
+            self.jobs.start_job_with_retries(n, batches=batches)
+            for n in self._flow_job_names(flow_name)
+        ]
+
+    def stop_jobs(self, flow_name: str) -> List[dict]:
+        return [
+            self.jobs.stop_job_with_retries(n)
+            for n in self._flow_job_names(flow_name)
+        ]
+
+    def restart_jobs(self, flow_name: str, batches: Optional[int] = None) -> List[dict]:
+        return [
+            self.jobs.restart_job(n, batches=batches)
+            for n in self._flow_job_names(flow_name)
+        ]
+
+    def sync_jobs(self, flow_name: Optional[str] = None) -> List[dict]:
+        if flow_name is None:
+            return self.jobs.sync_all()
+        return [self.jobs.sync_job_state(n) for n in self._flow_job_names(flow_name)]
+
+    def schedule_batch(self, flow_name: str) -> List[dict]:
+        """Trigger one batch round for a batch-mode flow
+        (reference: FlowOperation.ScheduleBatch, FlowOperation.cs:88 —
+        recurring scheduling is the TimedScheduler's job)."""
+        res = self.generate_configs(flow_name)
+        if not res.ok:
+            raise RuntimeError(f"generateconfigs failed: {res.errors}")
+        return self.start_jobs(flow_name)
+
+    # -- delete cascade --------------------------------------------------
+    def delete_flow(self, flow_name: str) -> bool:
+        """Stop jobs, drop runtime artifacts + job records + flow doc
+        (reference: DataX.Flow.DeleteHelper cascade)."""
+        doc = self.design.get_by_name(flow_name)
+        if doc is None:
+            return False
+        for job_name in doc.get("jobNames") or []:
+            try:
+                self.jobs.stop_job_with_retries(job_name)
+            except Exception:  # noqa: BLE001 — best-effort stop during delete
+                logger.warning("failed stopping job %s during delete", job_name)
+            self.registry.delete(job_name)
+        self.runtime.delete_all(flow_name)
+        return self.design.delete(flow_name)

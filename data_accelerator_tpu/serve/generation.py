@@ -25,6 +25,7 @@ import copy
 import json
 import logging
 import os
+import re
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -114,11 +115,20 @@ class RuntimeConfigGeneration:
         cp.setdefault("jobs", [{"partitionJobNumber": "1"}])
         ctx["job_common"] = dict(cp.get("jobCommonTokens") or {})
 
+    _NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.-]*$")
+
     def _s300_validate(self, ctx) -> None:
         doc = ctx["doc"]
         gui = doc["gui"]
         if not doc.get("name"):
             raise ValueError("flow has no name")
+        # the name becomes a filesystem folder under the runtime root;
+        # reject separators/'..' so generated files can't escape it
+        if not self._NAME_RE.match(doc["name"]):
+            raise ValueError(
+                f"invalid flow name '{doc['name']}': use letters, digits, "
+                "'_', '-', '.'"
+            )
         mode = (gui.get("input") or {}).get("mode", "streaming")
         if mode not in ("streaming", "batching"):
             raise ValueError(f"unknown input mode '{mode}'")
@@ -425,12 +435,12 @@ class RuntimeConfigGeneration:
         for job_name, conf_path in zip(
             ctx["result"].job_names, ctx["result"].conf_paths
         ):
+            existing = self.jobs.get(job_name)
             self.jobs.upsert({
                 "name": job_name,
                 "flow": ctx["doc"]["name"],
                 "confPath": conf_path,
-                "state": self.jobs.get(job_name, ).get("state")
-                if self.jobs.get(job_name) else "idle",
+                "state": (existing or {}).get("state") or "idle",
             })
 
     def _s850_metrics(self, ctx) -> None:

@@ -1,0 +1,234 @@
+"""Job lifecycle: submit/stop/sync engine jobs on TPU hosts.
+
+reference: DataX.Config's job layer —
+- ``ISparkJobClient`` (DataX.Config/Client/ISparkJobClient.cs): the
+  cluster-client interface (submit/stop/get state) with Livy, Databricks
+  and local spark-submit implementations -> ``TpuJobClient`` here, with
+  ``LocalJobClient`` spawning the streaming host as a child process
+  (DataX.Config.Local/LocalSparkClient.cs:18-180 semantics: process
+  handle is the job id, state from process liveness).
+- ``SparkJobOperation`` (InternalService/SparkJobOperation.cs:42-268):
+  start/stop/restart with bounded retries + state sync against the
+  client -> ``JobOperation``.
+- ``JobState`` (InternalService/JobState.cs): Idle/Starting/Running/
+  Success/Error.
+
+TPU flavor: a "cluster" is a set of TPU-VM hosts running the engine
+process; the local client covers the one-box and single-host cases, and
+the same interface carries a gRPC/SSH remote client for pods.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from .storage import JobRegistry
+
+logger = logging.getLogger(__name__)
+
+
+class JobState:
+    Idle = "idle"
+    Starting = "starting"
+    Running = "running"
+    Success = "success"
+    Error = "error"
+
+
+class TpuJobClient:
+    """Cluster-client interface (ISparkJobClient analog)."""
+
+    def submit(self, job: dict) -> dict:
+        """Start the job; returns updated job record (clientId, state)."""
+        raise NotImplementedError
+
+    def stop(self, job: dict) -> dict:
+        raise NotImplementedError
+
+    def get_state(self, job: dict) -> str:
+        raise NotImplementedError
+
+
+class LocalJobClient(TpuJobClient):
+    """Runs each job as a local engine process.
+
+    reference: LocalSparkClient.cs:21,112-140 — spark-submit with
+    ``--master local[*]``, pid tracked in the job record, state derived
+    from process table. Here: ``python -m data_accelerator_tpu.runtime.host
+    conf=<path>`` with optional env overrides (platform, chip count).
+    """
+
+    def __init__(self, log_dir: Optional[str] = None, env: Optional[dict] = None):
+        self.log_dir = log_dir
+        self.env = env or {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+
+    def submit(self, job: dict) -> dict:
+        name = job["name"]
+        conf_path = job["confPath"]
+        cmd = [
+            sys.executable, "-m", "data_accelerator_tpu.runtime.host",
+            f"conf={conf_path}",
+        ]
+        if job.get("batches"):
+            cmd.append(f"batches={job['batches']}")
+        env = {**os.environ, **self.env}
+        stdout = subprocess.DEVNULL
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            stdout = open(os.path.join(self.log_dir, f"{name}.log"), "ab")
+        try:
+            proc = subprocess.Popen(
+                cmd, stdout=stdout, stderr=subprocess.STDOUT, env=env,
+                start_new_session=True,
+            )
+        finally:
+            if stdout is not subprocess.DEVNULL:
+                stdout.close()  # child keeps its inherited fd
+        self._procs[name] = proc
+        job["clientId"] = proc.pid
+        job["state"] = JobState.Starting
+        logger.info("submitted job %s pid=%d conf=%s", name, proc.pid, conf_path)
+        return job
+
+    def _proc(self, job: dict) -> Optional[subprocess.Popen]:
+        return self._procs.get(job["name"])
+
+    def stop(self, job: dict) -> dict:
+        # forget the process so a later get_state doesn't read the
+        # SIGTERM exit code as a job failure
+        proc = self._procs.pop(job["name"], None)
+        pid = job.get("clientId")
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+        elif pid:
+            # job from a previous service instance: signal by pid
+            try:
+                os.kill(int(pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+        job["state"] = JobState.Idle
+        job["clientId"] = None
+        return job
+
+    def get_state(self, job: dict) -> str:
+        proc = self._proc(job)
+        if proc is not None:
+            rc = proc.poll()
+            if rc is None:
+                return JobState.Running
+            return JobState.Success if rc == 0 else JobState.Error
+        pid = job.get("clientId")
+        if pid:
+            try:
+                os.kill(int(pid), 0)
+                return JobState.Running
+            except (ProcessLookupError, PermissionError):
+                return JobState.Error
+        return job.get("state") or JobState.Idle
+
+
+class JobOperation:
+    """Start/stop/restart with bounded retries + state sync.
+
+    reference: SparkJobOperation.cs:42-268 (StartJobWithRetries /
+    StopJobWithRetries / RestartJob / SyncJobState / SyncAllJobState).
+    """
+
+    def __init__(
+        self,
+        registry: JobRegistry,
+        client: TpuJobClient,
+        retries: int = 3,
+        retry_interval_s: float = 0.5,
+    ):
+        self.registry = registry
+        self.client = client
+        self.retries = retries
+        self.retry_interval_s = retry_interval_s
+
+    # -- state sync ------------------------------------------------------
+    def sync_job_state(self, job_name: str) -> dict:
+        job = self.registry.get(job_name)
+        if job is None:
+            raise KeyError(f"job '{job_name}' not found")
+        state = self.client.get_state(job)
+        if state != job.get("state"):
+            job["state"] = state
+            self.registry.upsert(job)
+        return job
+
+    def sync_all(self) -> List[dict]:
+        return [self.sync_job_state(j["name"]) for j in self.registry.get_all()]
+
+    # -- lifecycle -------------------------------------------------------
+    def start_job(self, job_name: str, batches: Optional[int] = None) -> dict:
+        job = self.sync_job_state(job_name)
+        if job["state"] in (JobState.Running, JobState.Starting):
+            return job  # idempotent start (reference: StartJob short-circuit)
+        if batches:
+            job["batches"] = batches
+        job = self.client.submit(job)
+        self.registry.upsert(job)
+        return job
+
+    def start_job_with_retries(self, job_name: str, **kw) -> dict:
+        return self._with_retries(lambda: self.start_job(job_name, **kw))
+
+    def stop_job(self, job_name: str) -> dict:
+        job = self.sync_job_state(job_name)
+        if job["state"] not in (JobState.Running, JobState.Starting):
+            return job
+        job = self.client.stop(job)
+        self.registry.upsert(job)
+        return job
+
+    def stop_job_with_retries(self, job_name: str) -> dict:
+        return self._with_retries(lambda: self.stop_job(job_name))
+
+    def restart_job(self, job_name: str, batches: Optional[int] = None) -> dict:
+        self.stop_job_with_retries(job_name)
+        # wait until the client reports not-running before resubmitting
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if self.sync_job_state(job_name)["state"] not in (
+                JobState.Running, JobState.Starting,
+            ):
+                break
+            time.sleep(self.retry_interval_s)
+        return self.start_job_with_retries(job_name, batches=batches)
+
+    def wait_for_state(
+        self, job_name: str, states, timeout_s: float = 30
+    ) -> dict:
+        """Poll sync until the job reaches one of ``states``
+        (EnsureJobState semantics, SparkJobOperation.cs:229-266)."""
+        deadline = time.time() + timeout_s
+        job = self.sync_job_state(job_name)
+        while job["state"] not in states and time.time() < deadline:
+            time.sleep(self.retry_interval_s)
+            job = self.sync_job_state(job_name)
+        return job
+
+    def _with_retries(self, fn):
+        last: Optional[Exception] = None
+        for _ in range(self.retries):
+            try:
+                return fn()
+            except Exception as e:  # noqa: BLE001 — retried, then re-raised
+                last = e
+                logger.warning("job operation failed, retrying: %s", e)
+                time.sleep(self.retry_interval_s)
+        raise last  # type: ignore[misc]

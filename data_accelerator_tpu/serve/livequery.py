@@ -1,0 +1,230 @@
+"""LiveQuery: interactive query kernels over sampled live data.
+
+reference: DataX.Flow/DataX.Flow.InteractiveQuery —
+``InteractiveQueryManager`` creates a remote Jupyter kernel on the Spark
+cluster (HDInsightKernelService.cs:47-57), initializes it with the
+flow's sampled input + normalization + UDFs/refdata
+(KernelService.cs:67-130), executes the user's query and returns table
+JSON capped at a max row count (KernelService.cs:451-540), and recycles
+kernels via a tracked kernel list (KernelService.cs:135-190).
+
+TPU-native shape: a kernel is an in-process object holding the sampled
+batch; queries compile through the SAME FlowProcessor pipeline compiler
+the production engine uses — the property the reference gets by running
+the same Spark on both paths, we get by construction. Compiled
+processors are cached per query text, so re-running an edited query
+only recompiles the change.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..constants import DatasetName
+from ..core.config import SettingDictionary
+from ..compile.transform_parser import TransformParser
+
+_WINDOWED_TABLE_RE = re.compile(rf"\b{DatasetName.DataStreamProjection}_\w+\b")
+
+DEFAULT_MAX_ROWS = 100
+DEFAULT_KERNEL_TTL_S = 30 * 60
+DEFAULT_MAX_KERNELS = 16
+
+
+def _capacity_for(n: int) -> int:
+    cap = 64
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+@dataclass
+class Kernel:
+    """One interactive session's compiled state."""
+
+    id: str
+    flow_name: str
+    schema_json: str
+    normalization: str
+    sample_rows: List[dict]
+    udfs: Optional[dict] = None
+    refdata_conf: Dict[str, str] = field(default_factory=dict)
+    created_at: float = field(default_factory=time.time)
+    last_used: float = field(default_factory=time.time)
+    _processors: Dict[str, object] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def _conf(self, transform_text: str) -> SettingDictionary:
+        conf = {
+            "datax.job.name": f"LiveQuery-{self.flow_name}",
+            "datax.job.input.default.inputtype": "local",
+            "datax.job.input.default.blobschemafile": self.schema_json,
+            "datax.job.process.transform": transform_text,
+            "datax.job.process.projection": self.normalization,
+        }
+        conf.update(self.refdata_conf)
+        return SettingDictionary(conf)
+
+    def _rewrite_windowed(self, query: str) -> str:
+        """Windowed views over the sample alias to the full sample (the
+        kernel's sampled span IS the window; production windows come from
+        the runtime ring buffers)."""
+        return _WINDOWED_TABLE_RE.sub(DatasetName.DataStreamProjection, query)
+
+    def execute(self, query: str, max_rows: int = DEFAULT_MAX_ROWS) -> dict:
+        """Compile + run the query against the sampled batch; returns
+        {"headers": [...], "result": [rows]} like the reference's
+        ConvertToJson (KernelService.cs:700)."""
+        from ..runtime.processor import FlowProcessor
+
+        self.last_used = time.time()
+        text = self._rewrite_windowed(query.strip())
+        if not text:
+            return {"headers": [], "result": []}
+
+        # target dataset: last named assignment in the script
+        parsed = TransformParser.parse(text.splitlines())
+        names = [c.name for c in parsed.commands if c.name]
+        if not names:
+            # bare SELECT: wrap into an assignment
+            text = f"__livequery__ = {text}"
+            names = ["__livequery__"]
+        target = names[-1]
+
+        with self._lock:
+            proc = self._processors.get(text)
+            if proc is None:
+                proc = FlowProcessor(
+                    self._conf(text),
+                    batch_capacity=_capacity_for(len(self.sample_rows)),
+                    output_datasets=[target],
+                    udfs=self.udfs,
+                )
+                self._processors[text] = proc
+
+        base_ms = int(time.time() * 1000)
+        raw = proc.encode_rows(self.sample_rows, (base_ms // 1000) * 1000)
+        datasets, _metrics = proc.process_batch(raw, batch_time_ms=base_ms)
+        rows = datasets.get(target, [])[:max_rows]
+        headers = list(rows[0].keys()) if rows else []
+        return {"headers": headers, "result": rows, "table": target}
+
+
+class KernelService:
+    """Kernel registry with TTL GC (KernelService.cs:135-190 analog)."""
+
+    def __init__(
+        self,
+        runtime_storage=None,
+        ttl_s: float = DEFAULT_KERNEL_TTL_S,
+        max_kernels: int = DEFAULT_MAX_KERNELS,
+    ):
+        self.runtime = runtime_storage
+        self.ttl_s = ttl_s
+        self.max_kernels = max_kernels
+        self._kernels: Dict[str, Kernel] = {}
+        self._lock = threading.Lock()
+
+    # -- lifecycle -------------------------------------------------------
+    def create_kernel(
+        self,
+        flow_name: str,
+        schema_json: str,
+        normalization: str = "Raw.*",
+        sample_rows: Optional[List[dict]] = None,
+        udfs: Optional[dict] = None,
+        refdata_conf: Optional[Dict[str, str]] = None,
+    ) -> str:
+        """Create + initialize a kernel; returns kernel id.
+
+        Sample rows default to the flow's persisted sample blob
+        (written by SchemaInferenceManager)."""
+        if sample_rows is None:
+            sample_rows = self._load_sample(flow_name)
+        if not isinstance(schema_json, str):
+            schema_json = json.dumps(schema_json)
+        kid = uuid.uuid4().hex[:12]
+        kernel = Kernel(
+            id=kid,
+            flow_name=flow_name,
+            schema_json=schema_json,
+            normalization=normalization,
+            sample_rows=sample_rows or [],
+            udfs=udfs,
+            refdata_conf=refdata_conf or {},
+        )
+        with self._lock:
+            self._gc_locked()
+            self._kernels[kid] = kernel
+        return kid
+
+    def _load_sample(self, flow_name: str) -> List[dict]:
+        if self.runtime is None:
+            return []
+        rel = f"{flow_name}/samples/sample.json"
+        if not self.runtime.exists(rel):
+            return []
+        return [
+            json.loads(ln)
+            for ln in self.runtime.read_file(rel).splitlines()
+            if ln.strip()
+        ]
+
+    def get(self, kernel_id: str) -> Kernel:
+        with self._lock:
+            k = self._kernels.get(kernel_id)
+        if k is None:
+            raise KeyError(f"kernel '{kernel_id}' not found (recycled?)")
+        return k
+
+    def execute(
+        self, kernel_id: str, query: str, max_rows: int = DEFAULT_MAX_ROWS
+    ) -> dict:
+        return self.get(kernel_id).execute(query, max_rows)
+
+    def delete_kernel(self, kernel_id: str) -> bool:
+        with self._lock:
+            return self._kernels.pop(kernel_id, None) is not None
+
+    def delete_kernels(self, flow_name: Optional[str] = None) -> int:
+        """Recycle all kernels (optionally per flow)."""
+        with self._lock:
+            doomed = [
+                kid for kid, k in self._kernels.items()
+                if flow_name is None or k.flow_name == flow_name
+            ]
+            for kid in doomed:
+                del self._kernels[kid]
+            return len(doomed)
+
+    def list_kernels(self) -> List[dict]:
+        with self._lock:
+            return [
+                {
+                    "id": k.id,
+                    "flow": k.flow_name,
+                    "createdAt": k.created_at,
+                    "lastUsed": k.last_used,
+                    "sampleRows": len(k.sample_rows),
+                }
+                for k in self._kernels.values()
+            ]
+
+    # -- GC --------------------------------------------------------------
+    def _gc_locked(self) -> None:
+        now = time.time()
+        expired = [
+            kid for kid, k in self._kernels.items()
+            if now - k.last_used > self.ttl_s
+        ]
+        for kid in expired:
+            del self._kernels[kid]
+        while len(self._kernels) >= self.max_kernels:
+            oldest = min(self._kernels.values(), key=lambda k: k.last_used)
+            del self._kernels[oldest.id]

@@ -1,0 +1,415 @@
+"""Control-plane REST service.
+
+reference: the four DataX.Flow micro-services + gateway, collapsed into
+one process (the reference's one-box does the same — Flow.ManagementService
+hosts everything in DeploymentLocal/Dockerfile):
+
+- ``api/flow/*``      — Flow.ManagementService
+  (FlowManagementController.cs:51-249: save, generateconfigs, get,
+  getall, startjobs, stopjobs, restartjobs, schedulebatch, job/*)
+- ``api/userqueries/*`` — SqlParser schema + codegen endpoints
+  (FlowManagementController.cs:246-301)
+- ``api/inputdata/*`` — Flow.SchemaInferenceService
+  (SchemaInferenceController.cs:33-52)
+- ``api/kernel*``     — Flow.InteractiveQueryService
+  (InteractiveQueryController.cs:33-171)
+- role gate          — DataX.Gateway role/whitelist check
+  (GatewayController.cs:113-148): callers present roles in the
+  ``X-DataX-Roles`` header; writer endpoints need the writer role.
+
+Responses use the DataX.Contract ApiResult envelope:
+``{"result": ...}`` on success, ``{"error": {"message": ...}}`` on
+failure. Run: ``python -m data_accelerator_tpu.serve [port=5000]``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..compile.codegen import CodegenEngine
+from .flowservice import FlowOperation
+from .livequery import KernelService
+from .schemainference import SchemaInferenceManager
+from .sqlanalyzer import SqlAnalyzer
+
+logger = logging.getLogger(__name__)
+
+ROLE_READER = "DataXReader"
+ROLE_WRITER = "DataXWriter"
+
+
+class ApiError(Exception):
+    def __init__(self, message: str, status: int = 400):
+        super().__init__(message)
+        self.status = status
+
+
+class DataXApi:
+    """Route table + handlers over the service objects (transport-free,
+    so tests can call ``dispatch`` directly)."""
+
+    def __init__(
+        self,
+        flow_ops: FlowOperation,
+        kernels: Optional[KernelService] = None,
+        require_roles: bool = False,
+    ):
+        self.flow_ops = flow_ops
+        self.kernels = kernels or KernelService(
+            runtime_storage=flow_ops.runtime
+        )
+        self.schema_inference = SchemaInferenceManager(flow_ops.runtime)
+        self.analyzer = SqlAnalyzer()
+        self.codegen = CodegenEngine()
+        self.require_roles = require_roles
+        # (method, path) -> (handler, needs_writer)
+        self.routes: Dict[Tuple[str, str], Tuple[Callable, bool]] = {}
+        self._register()
+
+    def _register(self) -> None:
+        r = self.routes
+        r[("POST", "flow/save")] = (self._flow_save, True)
+        r[("POST", "flow/generateconfigs")] = (self._flow_generate, True)
+        r[("POST", "flow/startjobs")] = (self._flow_start, True)
+        r[("POST", "flow/stopjobs")] = (self._flow_stop, True)
+        r[("POST", "flow/restartjobs")] = (self._flow_restart, True)
+        r[("POST", "flow/schedulebatch")] = (self._flow_schedulebatch, True)
+        r[("POST", "flow/delete")] = (self._flow_delete, True)
+        r[("GET", "flow/get")] = (self._flow_get, False)
+        r[("GET", "flow/getall")] = (self._flow_getall, False)
+        r[("GET", "flow/getall/min")] = (self._flow_getall_min, False)
+        r[("GET", "job/getall")] = (self._job_getall, False)
+        r[("GET", "job/get")] = (self._job_get, False)
+        r[("POST", "job/getbynames")] = (self._job_getbynames, False)
+        r[("POST", "job/syncall")] = (self._job_syncall, True)
+        r[("POST", "userqueries/schema")] = (self._userquery_schema, False)
+        r[("POST", "userqueries/codegen")] = (self._userquery_codegen, False)
+        r[("POST", "inputdata/inferschema")] = (self._infer_schema, True)
+        r[("POST", "inputdata/refreshsample")] = (self._infer_schema, True)
+        r[("POST", "kernel")] = (self._kernel_create, True)
+        r[("POST", "kernel/refresh")] = (self._kernel_refresh, True)
+        r[("POST", "kernel/executequery")] = (self._kernel_execute, False)
+        r[("POST", "kernel/delete")] = (self._kernel_delete, True)
+        r[("POST", "kernels/deleteall")] = (self._kernels_deleteall, True)
+        r[("GET", "kernels/list")] = (self._kernels_list, False)
+
+    # -- dispatch --------------------------------------------------------
+    def dispatch(
+        self,
+        method: str,
+        path: str,
+        body: Optional[dict] = None,
+        query: Optional[dict] = None,
+        roles: Optional[list] = None,
+    ) -> Tuple[int, dict]:
+        """Returns (http_status, ApiResult envelope)."""
+        path = path.strip("/")
+        if path.startswith("api/"):
+            path = path[len("api/"):]
+        entry = self.routes.get((method.upper(), path))
+        if entry is None:
+            return 404, {"error": {"message": f"unknown route {method} {path}"}}
+        handler, needs_writer = entry
+        if self.require_roles:
+            roles = roles or []
+            if ROLE_READER not in roles and ROLE_WRITER not in roles:
+                return 401, {"error": {"message": "caller has no DataX role"}}
+            if needs_writer and ROLE_WRITER not in roles:
+                return 403, {"error": {"message": "writer role required"}}
+        try:
+            result = handler(body or {}, query or {})
+            return 200, {"result": result}
+        except ApiError as e:
+            return e.status, {"error": {"message": str(e)}}
+        except KeyError as e:
+            return 404, {"error": {"message": str(e)}}
+        except Exception as e:  # noqa: BLE001 — API boundary
+            logger.exception("api error on %s %s", method, path)
+            return 500, {"error": {"message": f"{type(e).__name__}: {e}"}}
+
+    # -- helpers ---------------------------------------------------------
+    @staticmethod
+    def _flow_name(body: dict, query: dict) -> str:
+        name = (
+            body.get("flowName") or body.get("name")
+            or (query.get("flowName") or [None])[0]
+            or (query.get("flowname") or [None])[0]
+        )
+        if isinstance(name, list):
+            name = name[0]
+        if not name:
+            raise ApiError("flowName required")
+        return name
+
+    # -- flow ------------------------------------------------------------
+    def _flow_save(self, body, query):
+        gui = body.get("gui") or body
+        doc = self.flow_ops.save_flow(gui)
+        return {"name": doc["name"], "displayName": doc.get("displayName")}
+
+    def _flow_generate(self, body, query):
+        res = self.flow_ops.generate_configs(self._flow_name(body, query))
+        if not res.ok:
+            raise ApiError("; ".join(res.errors), status=500)
+        return {
+            "flowName": res.flow_name,
+            "jobNames": res.job_names,
+            "confPaths": res.conf_paths,
+        }
+
+    def _flow_start(self, body, query):
+        return self.flow_ops.start_jobs(
+            self._flow_name(body, query), batches=body.get("batches")
+        )
+
+    def _flow_stop(self, body, query):
+        return self.flow_ops.stop_jobs(self._flow_name(body, query))
+
+    def _flow_restart(self, body, query):
+        return self.flow_ops.restart_jobs(
+            self._flow_name(body, query), batches=body.get("batches")
+        )
+
+    def _flow_schedulebatch(self, body, query):
+        return self.flow_ops.schedule_batch(self._flow_name(body, query))
+
+    def _flow_delete(self, body, query):
+        return {"deleted": self.flow_ops.delete_flow(self._flow_name(body, query))}
+
+    def _flow_get(self, body, query):
+        doc = self.flow_ops.get_flow(self._flow_name(body, query))
+        if doc is None:
+            raise ApiError("flow not found", status=404)
+        return doc
+
+    def _flow_getall(self, body, query):
+        return self.flow_ops.get_all_flows()
+
+    def _flow_getall_min(self, body, query):
+        return [
+            {
+                "name": d["name"],
+                "displayName": d.get("displayName"),
+                "jobNames": d.get("jobNames") or [],
+            }
+            for d in self.flow_ops.get_all_flows()
+        ]
+
+    # -- jobs ------------------------------------------------------------
+    def _job_getall(self, body, query):
+        return self.flow_ops.registry.get_all()
+
+    def _job_get(self, body, query):
+        name = (query.get("jobName") or [None])[0] or body.get("jobName")
+        if not name:
+            raise ApiError("jobName required")
+        job = self.flow_ops.registry.get(name)
+        if job is None:
+            raise ApiError("job not found", status=404)
+        return job
+
+    def _job_getbynames(self, body, query):
+        names = body.get("jobNames") or []
+        return [self.flow_ops.registry.get(n) for n in names]
+
+    def _job_syncall(self, body, query):
+        return self.flow_ops.sync_jobs()
+
+    # -- user queries ----------------------------------------------------
+    def _userquery_schema(self, body, query):
+        res = self.analyzer.analyze(
+            body.get("query") or "",
+            input_columns=body.get("inputColumns") or [],
+        )
+        return {
+            "tables": [
+                {
+                    "name": t.name,
+                    "columns": t.columns,
+                    "dependsOn": t.depends_on,
+                }
+                for t in res.tables
+            ],
+            "errors": res.errors,
+        }
+
+    def _userquery_codegen(self, body, query):
+        rc = self.codegen.generate_code(
+            body.get("query") or "",
+            json.dumps(body.get("rules") or []),
+            body.get("name") or "",
+        )
+        return {
+            "code": rc.code,
+            "outputs": rc.outputs,
+            "timeWindows": rc.time_windows,
+            "accumulationTables": rc.accumulation_tables,
+        }
+
+    # -- schema inference ------------------------------------------------
+    def _infer_schema(self, body, query):
+        name = body.get("name") or body.get("flowName") or ""
+        events = body.get("events")
+        seconds = float(body.get("seconds") or 2.0)
+        if events is None:
+            events = self._sample_from_flow(name, seconds, body)
+        return self.schema_inference.get_input_schema(
+            events=events, flow_name=name
+        )
+
+    def _sample_from_flow(self, name: str, seconds: float, body: dict):
+        """Sample from the flow's configured input (local source built
+        from the designer's schema — the one-box path; remote bus
+        sampling plugs in here)."""
+        from ..core.schema import Schema
+        from ..runtime.sources import LocalSource
+
+        schema_json = body.get("inputSchema")
+        if not schema_json and name:
+            doc = self.flow_ops.get_flow(name)
+            if doc:
+                schema_json = (
+                    ((doc.get("gui") or {}).get("input") or {})
+                    .get("properties") or {}
+                ).get("inputSchemaFile")
+        if not schema_json:
+            raise ApiError(
+                "no events supplied and no input schema available to sample"
+            )
+        src = LocalSource(Schema.from_spark_json(schema_json))
+        return self.schema_inference.sample_events(src, seconds=seconds)
+
+    # -- kernels ---------------------------------------------------------
+    def _kernel_body(self, body) -> dict:
+        name = body.get("name") or body.get("flowName") or ""
+        schema_json = body.get("inputSchema")
+        normalization = body.get("normalizationSnippet") or "Raw.*"
+        if not schema_json and name:
+            doc = self.flow_ops.get_flow(name)
+            if doc:
+                props = (
+                    ((doc.get("gui") or {}).get("input") or {})
+                    .get("properties") or {}
+                )
+                schema_json = props.get("inputSchemaFile")
+                normalization = (
+                    body.get("normalizationSnippet")
+                    or props.get("normalizationSnippet")
+                    or "Raw.*"
+                )
+        if not schema_json:
+            raise ApiError("inputSchema required (or a saved flow name)")
+        return {
+            "flow_name": name,
+            "schema_json": schema_json,
+            "normalization": normalization,
+            "sample_rows": body.get("sampleRows"),
+        }
+
+    def _kernel_create(self, body, query):
+        kw = self._kernel_body(body)
+        kid = self.kernels.create_kernel(**kw)
+        return {"kernelId": kid}
+
+    def _kernel_refresh(self, body, query):
+        """Recycle the flow's kernels and create a fresh one
+        (InteractiveQueryController kernel/refresh)."""
+        kw = self._kernel_body(body)
+        self.kernels.delete_kernels(kw["flow_name"])
+        kid = self.kernels.create_kernel(**kw)
+        return {"kernelId": kid}
+
+    def _kernel_execute(self, body, query):
+        kid = body.get("kernelId")
+        if not kid:
+            raise ApiError("kernelId required")
+        return self.kernels.execute(
+            kid, body.get("query") or "", int(body.get("maxRows") or 100)
+        )
+
+    def _kernel_delete(self, body, query):
+        kid = body.get("kernelId")
+        if not kid:
+            raise ApiError("kernelId required")
+        return {"deleted": self.kernels.delete_kernel(kid)}
+
+    def _kernels_deleteall(self, body, query):
+        return {"deleted": self.kernels.delete_kernels(body.get("flowName"))}
+
+    def _kernels_list(self, body, query):
+        return self.kernels.list_kernels()
+
+
+class DataXApiService:
+    """HTTP host for DataXApi (ThreadingHTTPServer)."""
+
+    def __init__(self, api: DataXApi, host: str = "127.0.0.1", port: int = 5000):
+        self.api = api
+        api_ref = api
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                logger.debug("http %s", fmt % args)
+
+            def _respond(self, status: int, payload: dict) -> None:
+                data = json.dumps(payload, default=str).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _roles(self):
+                hdr = self.headers.get("X-DataX-Roles") or ""
+                return [r.strip() for r in hdr.split(",") if r.strip()]
+
+            def _handle(self, method: str) -> None:
+                parsed = urlparse(self.path)
+                body = None
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    try:
+                        body = json.loads(self.rfile.read(length) or b"{}")
+                    except json.JSONDecodeError:
+                        self._respond(
+                            400, {"error": {"message": "invalid JSON body"}}
+                        )
+                        return
+                status, payload = api_ref.dispatch(
+                    method,
+                    parsed.path,
+                    body=body,
+                    query=parse_qs(parsed.query),
+                    roles=self._roles(),
+                )
+                self._respond(status, payload)
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_POST(self):
+                self._handle("POST")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        logger.info("DataX API listening on :%d", self.port)
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def serve_forever(self) -> None:
+        self._server.serve_forever()

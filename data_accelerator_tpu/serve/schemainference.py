@@ -1,0 +1,177 @@
+"""Schema inference: sampled JSON events -> Spark-style schema JSON.
+
+reference: DataX.Flow/DataX.Flow.SchemaInference —
+``SchemaInferenceManager.GetInputSchema`` samples N seconds of live
+events from the message bus ({Eventhub,Kafka,Blob}/*MessageBus.cs:43)
+and ``Engine.GetSchema``/``SchemaGenerator`` merges the JSON shapes into
+one schema document (Engine.cs:23-65) plus a sample-data blob consumed
+by LiveQuery kernel init (KernelService.cs:104-130).
+
+The schema format matches the engine's input contract
+(core/schema.py parse of ``{"type":"struct","fields":[...]}``).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+
+# -- type lattice ------------------------------------------------------------
+# merge order: conflicting scalars widen long->double->string
+_WIDEN = {
+    ("long", "double"): "double",
+    ("double", "long"): "double",
+}
+
+
+def _json_type(value: Any) -> Tuple[str, Any]:
+    """Returns (type-name or 'struct'/'array', nested payload)."""
+    if isinstance(value, bool):
+        return "boolean", None
+    if isinstance(value, int):
+        return "long", None
+    if isinstance(value, float):
+        return "double", None
+    if isinstance(value, str):
+        return "string", None
+    if isinstance(value, dict):
+        return "struct", value
+    if isinstance(value, list):
+        return "array", value
+    return "null", None  # None -> type decided by other samples
+
+
+@dataclass
+class _FieldAcc:
+    """Accumulated evidence for one field across samples."""
+
+    type: str = "null"
+    struct: Optional["_StructAcc"] = None
+    element: Optional["_FieldAcc"] = None
+    seen: int = 0
+    nullable: bool = False
+
+    def observe(self, value: Any) -> None:
+        self.seen += 1
+        t, payload = _json_type(value)
+        if t == "null":
+            self.nullable = True
+            return
+        if t == "struct":
+            if self.struct is None:
+                self.struct = _StructAcc()
+            self.struct.observe(payload)
+            self.type = "struct" if self.type in ("null", "struct") else "string"
+            return
+        if t == "array":
+            if self.element is None:
+                self.element = _FieldAcc()
+            for item in payload:
+                self.element.observe(item)
+            self.type = "array" if self.type in ("null", "array") else "string"
+            return
+        if self.type == "null":
+            self.type = t
+        elif self.type != t:
+            self.type = _WIDEN.get((self.type, t), "string")
+
+    def to_schema_type(self) -> Any:
+        if self.type == "struct" and self.struct is not None:
+            return self.struct.to_schema()
+        if self.type == "array":
+            elem = self.element.to_schema_type() if self.element else "string"
+            return {
+                "type": "array",
+                "elementType": elem,
+                "containsNull": True,
+            }
+        return self.type if self.type != "null" else "string"
+
+
+@dataclass
+class _StructAcc:
+    fields: Dict[str, _FieldAcc] = field(default_factory=dict)
+    samples: int = 0
+
+    def observe(self, obj: dict) -> None:
+        self.samples += 1
+        for k, v in obj.items():
+            self.fields.setdefault(k, _FieldAcc()).observe(v)
+
+    def to_schema(self) -> dict:
+        out = []
+        for name, acc in self.fields.items():
+            out.append({
+                "name": name,
+                "type": acc.to_schema_type(),
+                "nullable": acc.nullable or acc.seen < self.samples,
+                "metadata": {},
+            })
+        return {"type": "struct", "fields": out}
+
+
+def infer_schema(events: List[dict]) -> dict:
+    """Merge JSON event shapes into one struct schema
+    (reference: SchemaGenerator merge, Engine.cs:23-65)."""
+    acc = _StructAcc()
+    for e in events:
+        if isinstance(e, dict):
+            acc.observe(e)
+    return acc.to_schema()
+
+
+class SchemaInferenceManager:
+    """Sample a source for N seconds, emit schema + sample blob.
+
+    reference: SchemaInferenceManager.GetInputSchema +
+    EventhubMessageBus.GetSampleEvents(seconds).
+    """
+
+    def __init__(self, runtime_storage=None):
+        self.runtime = runtime_storage
+
+    def sample_events(
+        self, source, seconds: float = 5.0, max_events: int = 1000
+    ) -> List[dict]:
+        """Pull events from a runtime StreamingSource for ``seconds``."""
+        events: List[dict] = []
+        deadline = time.time() + seconds
+        while time.time() < deadline and len(events) < max_events:
+            rows, _offsets = source.poll(max_events - len(events))
+            source.ack()
+            events.extend(rows)
+            if not rows:
+                time.sleep(0.05)
+        return events
+
+    def get_input_schema(
+        self,
+        source=None,
+        events: Optional[List[dict]] = None,
+        flow_name: str = "",
+        seconds: float = 5.0,
+        max_events: int = 1000,
+    ) -> dict:
+        """Returns {"Schema": <schema json str>, "Samples": [...]} and, when
+        runtime storage is configured, persists the sample blob for
+        LiveQuery kernel init (the reference writes it to the flow's
+        sample folder)."""
+        if events is None:
+            if source is None:
+                raise ValueError("either source or events required")
+            events = self.sample_events(source, seconds, max_events)
+        schema = infer_schema(events)
+        result = {
+            "Schema": json.dumps(schema),
+            "Samples": events[:max_events],
+            "EventsSampled": len(events),
+        }
+        if self.runtime is not None and flow_name:
+            self.runtime.save_file(
+                f"{flow_name}/samples/sample.json",
+                "\n".join(json.dumps(e) for e in events),
+            )
+        return result

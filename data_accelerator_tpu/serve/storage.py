@@ -124,7 +124,12 @@ class LocalRuntimeStorage(RuntimeStorage):
         return os.path.exists(self.resolve(path))
 
     def delete_all(self, prefix: str) -> None:
-        full = self.resolve(prefix)
+        full = os.path.realpath(self.resolve(prefix))
+        root = os.path.realpath(self.root)
+        # recursive delete only ever inside the runtime root — a flow
+        # name is caller-supplied and must not reach rmtree unconfined
+        if not (full == root or full.startswith(root + os.sep)):
+            raise ValueError(f"refusing to delete outside runtime root: {prefix}")
         if os.path.isdir(full):
             shutil.rmtree(full, ignore_errors=True)
         elif os.path.exists(full):

@@ -1,0 +1,232 @@
+"""Design-time service tests: schema inference (merge semantics per
+DataX.Flow.SchemaInference.Tests fixtures), SQL analyzer intellisense
+(DataX.Flow.SqlParser.Tests analog), LiveQuery kernels
+(DataX.Flow.InteractiveQuery.Tests analog — here against the REAL
+engine, which the reference only achieves on a live cluster)."""
+
+import json
+import time
+
+import pytest
+
+from data_accelerator_tpu.serve.schemainference import (
+    SchemaInferenceManager,
+    infer_schema,
+)
+from data_accelerator_tpu.serve.sqlanalyzer import SqlAnalyzer
+from data_accelerator_tpu.serve.livequery import KernelService
+from data_accelerator_tpu.serve.storage import LocalRuntimeStorage
+
+
+# ---------------------------------------------------------------------------
+# schema inference
+# ---------------------------------------------------------------------------
+class TestInferSchema:
+    def test_scalar_types(self):
+        s = infer_schema([{"a": 1, "b": 2.5, "c": "x", "d": True}])
+        types = {f["name"]: f["type"] for f in s["fields"]}
+        assert types == {"a": "long", "b": "double", "c": "string", "d": "boolean"}
+
+    def test_long_double_widening(self):
+        s = infer_schema([{"v": 1}, {"v": 2.5}])
+        assert s["fields"][0]["type"] == "double"
+
+    def test_conflict_falls_back_to_string(self):
+        s = infer_schema([{"v": 1}, {"v": "x"}])
+        assert s["fields"][0]["type"] == "string"
+
+    def test_missing_field_nullable(self):
+        s = infer_schema([{"a": 1, "b": 2}, {"a": 3}])
+        by = {f["name"]: f for f in s["fields"]}
+        assert by["a"]["nullable"] is False
+        assert by["b"]["nullable"] is True
+
+    def test_nested_struct_merge(self):
+        s = infer_schema([
+            {"device": {"id": 1, "type": "DoorLock"}},
+            {"device": {"id": 2, "temp": 21.5}},
+        ])
+        dev = s["fields"][0]
+        assert dev["type"]["type"] == "struct"
+        inner = {f["name"]: f["type"] for f in dev["type"]["fields"]}
+        assert inner == {"id": "long", "type": "string", "temp": "double"}
+
+    def test_array_element_merge(self):
+        s = infer_schema([{"xs": [1, 2]}, {"xs": [3.5]}])
+        t = s["fields"][0]["type"]
+        assert t["type"] == "array"
+        assert t["elementType"] == "double"
+
+    def test_null_then_value(self):
+        s = infer_schema([{"v": None}, {"v": 5}])
+        f = s["fields"][0]
+        assert f["type"] == "long"
+        assert f["nullable"] is True
+
+
+class TestSamplingManager:
+    def test_sample_from_local_source(self, tmp_path):
+        from data_accelerator_tpu.core.schema import Schema
+        from data_accelerator_tpu.runtime.sources import LocalSource
+
+        schema_json = json.dumps({
+            "type": "struct",
+            "fields": [
+                {"name": "deviceId", "type": "long", "nullable": False,
+                 "metadata": {"allowedValues": [1, 2, 3]}},
+                {"name": "deviceType", "type": "string", "nullable": False,
+                 "metadata": {"allowedValues": ["DoorLock"]}},
+            ],
+        })
+        src = LocalSource(Schema.from_spark_json(schema_json))
+        runtime = LocalRuntimeStorage(str(tmp_path))
+        mgr = SchemaInferenceManager(runtime)
+        res = mgr.get_input_schema(
+            source=src, flow_name="SampFlow", seconds=0.3, max_events=50
+        )
+        assert res["EventsSampled"] > 0
+        inferred = json.loads(res["Schema"])
+        names = {f["name"] for f in inferred["fields"]}
+        assert {"deviceId", "deviceType"} <= names
+        # sample blob persisted for LiveQuery init
+        assert runtime.exists("SampFlow/samples/sample.json")
+
+
+# ---------------------------------------------------------------------------
+# SQL analyzer
+# ---------------------------------------------------------------------------
+class TestSqlAnalyzer:
+    SCRIPT = (
+        "--DataXQuery--\n"
+        "DoorEvents = SELECT deviceId, deviceType AS kind, status "
+        "FROM DataXProcessedInput WHERE status = 0;\n"
+        "--DataXQuery--\n"
+        "Counts = SELECT deviceId, COUNT(*) AS Cnt FROM DoorEvents "
+        "GROUP BY deviceId;\n"
+        "--DataXQuery--\n"
+        "Everything = SELECT * FROM DoorEvents;\n"
+    )
+
+    def test_table_graph_and_columns(self):
+        res = SqlAnalyzer().analyze(
+            self.SCRIPT, input_columns=["deviceId", "deviceType", "status"]
+        )
+        assert not res.errors
+        assert [t.name for t in res.tables] == ["DoorEvents", "Counts", "Everything"]
+        assert res.table("DoorEvents").columns == ["deviceId", "kind", "status"]
+        assert res.table("DoorEvents").depends_on == ["DataXProcessedInput"]
+        assert res.table("Counts").columns == ["deviceId", "Cnt"]
+        assert res.table("Counts").depends_on == ["DoorEvents"]
+        # * expanded from the known upstream table
+        assert res.table("Everything").columns == ["deviceId", "kind", "status"]
+
+    def test_windowed_table_inherits_input_columns(self):
+        script = (
+            "--DataXQuery--\n"
+            "W = SELECT deviceId FROM DataXProcessedInput_5minutes "
+            "GROUP BY deviceId;\n"
+        )
+        res = SqlAnalyzer().analyze(script, input_columns=["deviceId"])
+        assert not res.errors
+        assert res.table("W").depends_on == ["DataXProcessedInput_5minutes"]
+
+    def test_bad_sql_reports_error(self):
+        res = SqlAnalyzer().analyze("--DataXQuery--\nT = SELECTX nope;\n")
+        assert res.errors
+
+
+# ---------------------------------------------------------------------------
+# LiveQuery kernels
+# ---------------------------------------------------------------------------
+SCHEMA = json.dumps({
+    "type": "struct",
+    "fields": [
+        {"name": "deviceId", "type": "long", "nullable": False,
+         "metadata": {"allowedValues": [1, 2, 3]}},
+        {"name": "deviceType", "type": "string", "nullable": False,
+         "metadata": {"allowedValues": ["DoorLock", "Heating"]}},
+        {"name": "status", "type": "long", "nullable": False,
+         "metadata": {"allowedValues": [0, 1]}},
+    ],
+})
+
+SAMPLE = [
+    {"deviceId": 1, "deviceType": "DoorLock", "status": 0},
+    {"deviceId": 2, "deviceType": "DoorLock", "status": 1},
+    {"deviceId": 3, "deviceType": "Heating", "status": 1},
+    {"deviceId": 1, "deviceType": "DoorLock", "status": 0},
+]
+
+
+class TestLiveQuery:
+    def test_execute_query(self):
+        svc = KernelService()
+        kid = svc.create_kernel("LQFlow", SCHEMA, sample_rows=SAMPLE)
+        out = svc.execute(
+            kid,
+            "OpenDoors = SELECT deviceId, status FROM DataXProcessedInput "
+            "WHERE deviceType = 'DoorLock' AND status = 0",
+        )
+        assert out["table"] == "OpenDoors"
+        assert out["headers"] == ["deviceId", "status"]
+        assert sorted(r["deviceId"] for r in out["result"]) == [1, 1]
+
+    def test_bare_select_and_aggregation(self):
+        svc = KernelService()
+        kid = svc.create_kernel("LQFlow", SCHEMA, sample_rows=SAMPLE)
+        out = svc.execute(
+            kid,
+            "SELECT deviceType, COUNT(*) AS Cnt FROM DataXProcessedInput "
+            "GROUP BY deviceType",
+        )
+        got = {r["deviceType"]: r["Cnt"] for r in out["result"]}
+        assert got == {"DoorLock": 3, "Heating": 1}
+
+    def test_windowed_table_aliases_to_sample(self):
+        svc = KernelService()
+        kid = svc.create_kernel("LQFlow", SCHEMA, sample_rows=SAMPLE)
+        out = svc.execute(
+            kid,
+            "W = SELECT deviceId, COUNT(*) AS Cnt "
+            "FROM DataXProcessedInput_5minutes GROUP BY deviceId",
+        )
+        got = {r["deviceId"]: r["Cnt"] for r in out["result"]}
+        assert got == {1: 2, 2: 1, 3: 1}
+
+    def test_processor_cache_reused(self):
+        svc = KernelService()
+        kid = svc.create_kernel("LQFlow", SCHEMA, sample_rows=SAMPLE)
+        q = "T = SELECT deviceId FROM DataXProcessedInput"
+        svc.execute(kid, q)
+        k = svc.get(kid)
+        assert len(k._processors) == 1
+        svc.execute(kid, q)
+        assert len(k._processors) == 1  # same compiled processor reused
+
+    def test_kernel_gc_ttl_and_capacity(self):
+        svc = KernelService(ttl_s=0.01, max_kernels=2)
+        k1 = svc.create_kernel("F", SCHEMA, sample_rows=SAMPLE)
+        time.sleep(0.05)
+        k2 = svc.create_kernel("F", SCHEMA, sample_rows=SAMPLE)
+        # k1 expired by TTL during k2's create
+        assert [k["id"] for k in svc.list_kernels()] == [k2]
+        with pytest.raises(KeyError):
+            svc.get(k1)
+
+    def test_delete_kernels_per_flow(self):
+        svc = KernelService()
+        svc.create_kernel("A", SCHEMA, sample_rows=SAMPLE)
+        svc.create_kernel("B", SCHEMA, sample_rows=SAMPLE)
+        assert svc.delete_kernels("A") == 1
+        assert len(svc.list_kernels()) == 1
+
+    def test_sample_loaded_from_storage(self, tmp_path):
+        runtime = LocalRuntimeStorage(str(tmp_path))
+        runtime.save_file(
+            "SFlow/samples/sample.json",
+            "\n".join(json.dumps(r) for r in SAMPLE),
+        )
+        svc = KernelService(runtime_storage=runtime)
+        kid = svc.create_kernel("SFlow", SCHEMA)
+        out = svc.execute(kid, "T = SELECT deviceId FROM DataXProcessedInput")
+        assert len(out["result"]) == 4

@@ -162,6 +162,49 @@ class TestFlowBuilder:
             "deviceDetails.deviceType = 'DoorLock' AND deviceDetails.status = 0"
         )
 
+    def test_string_values_quote_escaped(self):
+        rules = [{
+            "id": "q", "type": "tag",
+            "properties": {
+                "_S_ruleType": "SimpleRule",
+                "schemaTableName": "DataXProcessedInput",
+                "conditions": {
+                    "type": "group", "conjunction": "and",
+                    "conditions": [
+                        {"type": "condition", "field": "owner",
+                         "operator": "stringEqual", "value": "O'Brien"},
+                    ],
+                },
+            },
+        }]
+        d = json.loads(RuleDefinitionGenerator().generate(rules, "p"))[0]
+        assert d["$condition"] == "owner = 'O''Brien'"
+
+    def test_empty_sibling_keeps_conjunction(self):
+        rules = [{
+            "id": "c", "type": "tag",
+            "properties": {
+                "_S_ruleType": "SimpleRule",
+                "schemaTableName": "DataXProcessedInput",
+                "conditions": {
+                    "type": "group", "conjunction": "and",
+                    "conditions": [
+                        {"type": "condition", "field": "a",
+                         "operator": "equal", "value": "1"},
+                        {"type": "group", "conjunction": "and",
+                         "conditions": []},  # renders empty
+                        {"type": "group", "conjunction": "or", "conditions": [
+                            {"type": "condition", "field": "b",
+                             "operator": "equal", "value": "2"},
+                        ]},
+                    ],
+                },
+            },
+        }]
+        d = json.loads(RuleDefinitionGenerator().generate(rules, "p"))[0]
+        # the OR belongs to the b-group, not the dropped empty sibling
+        assert d["$condition"] == "a = 1 OR (b = 2)"
+
     def test_aggregate_rule_condition(self):
         rules = [{
             "id": "hot", "type": "tag",
@@ -240,6 +283,23 @@ class TestGeneration:
         design, runtime = stores
         res = RuntimeConfigGeneration(design, runtime).generate("NoSuchFlow")
         assert not res.ok
+
+    def test_path_escaping_flow_name_rejected(self, stores):
+        design, runtime = stores
+        gui = make_gui("GenTestFlow")
+        gui["name"] = "../escape"
+        design.save({"name": "../escape", "gui": gui})
+        res = RuntimeConfigGeneration(design, runtime).generate("../escape")
+        assert not res.ok
+        assert "invalid flow name" in res.errors[0]
+
+    def test_delete_all_confined_to_root(self, stores, tmp_path):
+        _, runtime = stores
+        victim = tmp_path / "victim"
+        victim.mkdir()
+        with pytest.raises(ValueError):
+            runtime.delete_all(str(victim))
+        assert victim.exists()
 
     def test_generated_conf_runs_one_box(self, stores):
         """The LocalTests.cs analog: generated conf drives the real

@@ -1,0 +1,299 @@
+"""REST API + scenario e2e tests.
+
+Mirrors the reference's live-API scenario suite
+(Tests/DataXScenarios/{SaveAndDeploy,InteractiveQueryAndSchemaGen}
+Scenarios.cs driven by ScenarioTester over HTTP) and the gateway role
+checks (DataX.Gateway.Api.Tests)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from data_accelerator_tpu.serve.flowservice import FlowOperation
+from data_accelerator_tpu.serve.restapi import DataXApi, DataXApiService
+from data_accelerator_tpu.serve.scenario import Scenario, ScenarioContext
+from data_accelerator_tpu.serve.storage import (
+    LocalDesignTimeStorage,
+    LocalRuntimeStorage,
+)
+
+from test_serve_generation import make_gui, INPUT_SCHEMA
+from test_serve_jobs import FakeJobClient
+
+
+@pytest.fixture
+def api(tmp_path):
+    flow_ops = FlowOperation(
+        LocalDesignTimeStorage(str(tmp_path / "design")),
+        LocalRuntimeStorage(str(tmp_path / "runtime")),
+        job_client=FakeJobClient(),
+    )
+    return DataXApi(flow_ops)
+
+
+@pytest.fixture
+def server(api):
+    svc = DataXApiService(api, port=0)  # ephemeral port
+    svc.start()
+    yield svc
+    svc.stop()
+
+
+def http(server, method, path, body=None, roles=None):
+    url = f"http://127.0.0.1:{server.port}/{path.lstrip('/')}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Content-Type", "application/json")
+    if roles:
+        req.add_header("X-DataX-Roles", ",".join(roles))
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# ---------------------------------------------------------------------------
+# direct dispatch
+# ---------------------------------------------------------------------------
+class TestDispatch:
+    def test_unknown_route(self, api):
+        status, out = api.dispatch("GET", "api/nope")
+        assert status == 404
+
+    def test_flow_crud(self, api):
+        status, out = api.dispatch("POST", "api/flow/save", body=make_gui("ApiFlow"))
+        assert status == 200, out
+        assert out["result"]["name"] == "ApiFlow"
+        status, out = api.dispatch(
+            "POST", "api/flow/generateconfigs", body={"flowName": "ApiFlow"}
+        )
+        assert status == 200, out
+        assert out["result"]["jobNames"] == ["DataXTpu-ApiFlow"]
+        status, out = api.dispatch(
+            "GET", "api/flow/get", query={"flowName": ["ApiFlow"]}
+        )
+        assert out["result"]["jobNames"] == ["DataXTpu-ApiFlow"]
+        status, out = api.dispatch("GET", "api/flow/getall/min")
+        assert out["result"][0]["name"] == "ApiFlow"
+
+    def test_job_lifecycle_over_api(self, api):
+        api.dispatch("POST", "api/flow/save", body=make_gui("JFlow"))
+        api.dispatch("POST", "api/flow/generateconfigs", body={"flowName": "JFlow"})
+        status, out = api.dispatch(
+            "POST", "api/flow/startjobs", body={"flowName": "JFlow"}
+        )
+        assert status == 200
+        assert out["result"][0]["state"] == "starting"
+        status, out = api.dispatch("POST", "api/job/syncall", body={})
+        assert out["result"][0]["state"] == "running"
+        status, out = api.dispatch(
+            "POST", "api/flow/stopjobs", body={"flowName": "JFlow"}
+        )
+        assert out["result"][0]["state"] == "idle"
+
+    def test_userqueries_schema(self, api):
+        status, out = api.dispatch("POST", "api/userqueries/schema", body={
+            "query": "--DataXQuery--\nT = SELECT a, b AS c FROM DataXProcessedInput",
+            "inputColumns": ["a", "b"],
+        })
+        assert status == 200
+        assert out["result"]["tables"][0]["columns"] == ["a", "c"]
+
+    def test_userqueries_codegen(self, api):
+        status, out = api.dispatch("POST", "api/userqueries/codegen", body={
+            "query": "--DataXQuery--\nT = SELECT * FROM DataXProcessedInput "
+                     "TIMEWINDOW('2 minutes');\nOUTPUT T TO Metrics;",
+            "rules": [],
+            "name": "X",
+        })
+        assert status == 200
+        assert out["result"]["timeWindows"] == {
+            "DataXProcessedInput_2minutes": "2 minutes"
+        }
+
+    def test_infer_schema_from_events(self, api):
+        status, out = api.dispatch("POST", "api/inputdata/inferschema", body={
+            "name": "SFlow",
+            "events": [{"a": 1, "b": "x"}, {"a": 2.5}],
+        })
+        assert status == 200
+        schema = json.loads(out["result"]["Schema"])
+        types = {f["name"]: f["type"] for f in schema["fields"]}
+        assert types == {"a": "double", "b": "string"}
+
+    def test_kernel_roundtrip(self, api):
+        sample = [
+            {"deviceDetails": {"deviceId": 1, "deviceType": "DoorLock",
+                               "status": 0}},
+            {"deviceDetails": {"deviceId": 2, "deviceType": "Heating",
+                               "status": 1}},
+        ]
+        status, out = api.dispatch("POST", "api/kernel", body={
+            "name": "KFlow",
+            "inputSchema": INPUT_SCHEMA,
+            "sampleRows": sample,
+        })
+        assert status == 200, out
+        kid = out["result"]["kernelId"]
+        status, out = api.dispatch("POST", "api/kernel/executequery", body={
+            "kernelId": kid,
+            "query": "T = SELECT deviceDetails.deviceId AS id "
+                     "FROM DataXProcessedInput "
+                     "WHERE deviceDetails.status = 0",
+        })
+        assert status == 200, out
+        assert [r["id"] for r in out["result"]["result"]] == [1]
+        status, out = api.dispatch(
+            "POST", "api/kernel/delete", body={"kernelId": kid}
+        )
+        assert out["result"]["deleted"] is True
+
+
+class TestRoleGate:
+    def test_roles_enforced(self, tmp_path):
+        flow_ops = FlowOperation(
+            LocalDesignTimeStorage(str(tmp_path / "d")),
+            LocalRuntimeStorage(str(tmp_path / "r")),
+            job_client=FakeJobClient(),
+        )
+        api = DataXApi(flow_ops, require_roles=True)
+        status, _ = api.dispatch("GET", "api/flow/getall")
+        assert status == 401
+        status, _ = api.dispatch(
+            "GET", "api/flow/getall", roles=["DataXReader"]
+        )
+        assert status == 200
+        status, _ = api.dispatch(
+            "POST", "api/flow/save", body=make_gui("X"), roles=["DataXReader"]
+        )
+        assert status == 403
+        status, _ = api.dispatch(
+            "POST", "api/flow/save", body=make_gui("X"), roles=["DataXWriter"]
+        )
+        assert status == 200
+
+
+# ---------------------------------------------------------------------------
+# live HTTP + scenarios
+# ---------------------------------------------------------------------------
+class TestHttpServer:
+    def test_http_roundtrip(self, server):
+        status, out = http(server, "POST", "api/flow/save", make_gui("HFlow"))
+        assert status == 200
+        status, out = http(server, "GET", "api/flow/getall")
+        assert out["result"][0]["name"] == "HFlow"
+        status, out = http(server, "GET", "api/bogus")
+        assert status == 404
+
+
+class TestScenarios:
+    def test_save_and_deploy_scenario(self, server):
+        """SaveAndDeploy over live HTTP (DataXScenarios analog)."""
+        scn = Scenario("SaveAndDeploy")
+
+        @scn.step
+        def save_flow(ctx):
+            status, out = http(server, "POST", "api/flow/save",
+                               make_gui(ctx["flow"]))
+            assert status == 200, out
+
+        @scn.step
+        def generate_configs(ctx):
+            status, out = http(server, "POST", "api/flow/generateconfigs",
+                               {"flowName": ctx["flow"]})
+            assert status == 200, out
+            ctx["jobNames"] = out["result"]["jobNames"]
+
+        @scn.step
+        def start_jobs(ctx):
+            status, out = http(server, "POST", "api/flow/startjobs",
+                               {"flowName": ctx["flow"]})
+            assert status == 200, out
+
+        @scn.step
+        def stop_jobs(ctx):
+            status, out = http(server, "POST", "api/flow/stopjobs",
+                               {"flowName": ctx["flow"]})
+            assert status == 200, out
+
+        @scn.step
+        def delete_flow(ctx):
+            status, out = http(server, "POST", "api/flow/delete",
+                               {"flowName": ctx["flow"]})
+            assert status == 200 and out["result"]["deleted"], out
+
+        results = scn.run_parallel(
+            3, make_ctx=lambda i: ScenarioContext({"flow": f"ScnFlow{i}"})
+        )
+        for r in results:
+            assert r.success, r.failed_step
+        assert all(len(r.steps) == 5 for r in results)
+
+    def test_schema_and_query_scenario(self, server):
+        """InteractiveQueryAndSchemaGenScenarios analog: infer schema from
+        sampled events, spin a kernel, execute a query."""
+        scn = Scenario("SchemaAndQuery")
+        sample = [
+            {"deviceDetails": {"deviceId": i % 3, "deviceType": "DoorLock",
+                               "status": i % 2}}
+            for i in range(10)
+        ]
+
+        @scn.step
+        def infer_schema(ctx):
+            status, out = http(server, "POST", "api/inputdata/inferschema",
+                               {"name": "QScn", "events": sample})
+            assert status == 200, out
+            ctx["schema"] = out["result"]["Schema"]
+
+        @scn.step
+        def create_kernel(ctx):
+            status, out = http(server, "POST", "api/kernel", {
+                "name": "QScn",
+                "inputSchema": INPUT_SCHEMA,
+                "sampleRows": sample,
+            })
+            assert status == 200, out
+            ctx["kernelId"] = out["result"]["kernelId"]
+
+        @scn.step
+        def execute_query(ctx):
+            status, out = http(server, "POST", "api/kernel/executequery", {
+                "kernelId": ctx["kernelId"],
+                "query": "T = SELECT deviceDetails.deviceId AS id, COUNT(*) "
+                         "AS Cnt FROM DataXProcessedInput GROUP BY "
+                         "deviceDetails.deviceId",
+            })
+            assert status == 200, out
+            assert len(out["result"]["result"]) == 3
+
+        @scn.step
+        def recycle(ctx):
+            status, out = http(server, "POST", "api/kernels/deleteall",
+                               {"flowName": "QScn"})
+            assert status == 200, out
+
+        r = scn.run()
+        assert r.success, r.failed_step
+
+    def test_failing_step_aborts(self):
+        scn = Scenario("Fails")
+
+        @scn.step
+        def ok(ctx):
+            ctx["x"] = 1
+
+        @scn.step
+        def boom(ctx):
+            raise RuntimeError("nope")
+
+        @scn.step
+        def never(ctx):
+            ctx["never"] = True
+
+        r = scn.run()
+        assert not r.success
+        assert r.failed_step == "boom"
+        assert len(r.steps) == 2
