@@ -90,6 +90,16 @@ _OUTPUT_FIELDS = {
         "namespace": "console",
         "fields": {"maxRows": "maxrows"},
     },
+    "externalfn": {
+        "type": "object",
+        "namespace": "externalfn",
+        "fields": {
+            "serviceEndpoint": "serviceendpoint",
+            "api": "api",
+            "code": "code",
+            "methodType": "methodtype",
+        },
+    },
     "metric": "metric",
 }
 

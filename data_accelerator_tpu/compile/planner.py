@@ -120,6 +120,8 @@ class _AggCollector(ExprCompiler):
     def __init__(self, scope, dictionary, udfs):
         super().__init__(scope, dictionary, udfs)
         self.agg_nodes: Dict[str, Tuple[str, Optional[Expr], bool]] = {}
+        # custom aggregates (UDAF tier): key -> (udf, [arg exprs])
+        self.udaf_nodes: Dict[str, Tuple[object, Tuple[Expr, ...]]] = {}
         self._counter = itertools.count()
 
     def _func(self, e: Func):
@@ -128,6 +130,23 @@ class _AggCollector(ExprCompiler):
             arg = None if (not e.args or isinstance(e.args[0], Star)) else e.args[0]
             self.agg_nodes[key] = (e.name, arg, e.distinct)
             out_t = self._agg_type(e.name, arg)
+            return CompiledExpr(
+                out_t, lambda env, key=key: env.scopes["__agg"][key]
+            )
+        udaf = self.udfs.get(e.name.lower())
+        if udaf is not None and getattr(udaf, "is_aggregate", False):
+            key = f"agg{next(self._counter)}"
+            self.udaf_nodes[key] = (udaf, tuple(e.args))
+            plain = ExprCompiler(self.scope, self.dictionary, self.udfs)
+            arg_types = []
+            for a in e.args:
+                inner = plain.compile(a)
+                if not is_device(inner):
+                    raise EngineException(
+                        f"cannot aggregate non-device expression {a!r}"
+                    )
+                arg_types.append(inner.type)
+            out_t = udaf.result_type(arg_types)
             return CompiledExpr(
                 out_t, lambda env, key=key: env.scopes["__agg"][key]
             )
@@ -275,6 +294,12 @@ class SelectCompiler:
             return self._compile_grouped(
                 name, sel, scope, compiler, build_scope, scope_capacity,
                 where_fn, out_types, deferred, flat_outputs, out_values,
+            )
+
+        if compiler.udaf_nodes:
+            names = ", ".join(u.name for u, _ in compiler.udaf_nodes.values())
+            raise EngineException(
+                f"aggregate UDF ({names}) requires GROUP BY in {name}"
             )
 
         # 4. plain projection/filter
@@ -709,6 +734,14 @@ class SelectCompiler:
             agg_args[key] = (
                 None if arg is None else plain.compile_device(arg, f"{fname} argument")
             )
+        udaf_nodes = compiler.udaf_nodes
+        udaf_args: Dict[str, List[CompiledExpr]] = {
+            key: [
+                plain.compile_device(a, f"{udf.name} argument")
+                for a in args
+            ]
+            for key, (udf, args) in udaf_nodes.items()
+        }
 
         capacity = min(scope_capacity, self.config.max_group_capacity)
 
@@ -764,6 +797,9 @@ class SelectCompiler:
                         )
                     z = jnp.where(valid_s, vals, jnp.full_like(vals, ident))
                     agg_results[key] = segment_aggregate(z, seg, capacity, op, valid_s)
+            for key, (udf, _args) in udaf_nodes.items():
+                arg_arrays = [a.fn(env)[order] for a in udaf_args[key]]
+                agg_results[key] = udf.reduce(arg_arrays, seg, capacity, valid_s)
 
             # representative row per group (first sorted row)
             rep_sorted_idx, rep_valid = compact_indices(first, capacity)

@@ -150,7 +150,11 @@ class FlowProcessor:
     ):
         self.dict = dict_
         self.dictionary = dictionary or StringDictionary()
-        self.udfs = udfs or {}
+        # conf-declared UDFs (jar.udf/jar.udaf namespaces) + direct ones;
+        # reference: ExtendedUDFHandler/JarUDFHandler reflection loading
+        from ..udf import load_udfs_from_conf
+
+        self.udfs = {**load_udfs_from_conf(dict_), **(udfs or {})}
         self.mesh = mesh
 
         input_conf = dict_.get_sub_dictionary(SettingNamespace.JobInputPrefix)
@@ -481,6 +485,13 @@ class FlowProcessor:
         t0 = time.time()
         if batch_time_ms is None:
             batch_time_ms = int(time.time() * 1000)
+        # per-interval UDF refresh hooks; state changes re-trace the step
+        # (CommonProcessorFactory.scala:351-353 onInterval invocation)
+        from ..udf import UdfRegistry
+
+        if UdfRegistry(self.udfs).refresh(batch_time_ms):
+            self._build_pipeline(self.output_datasets)
+            self._jit_step()  # the old jit closed over the old pipeline
         # whole-second base so device absolute-time math is exact
         new_base_ms = (batch_time_ms // 1000) * 1000
         if self._base_ms is None:

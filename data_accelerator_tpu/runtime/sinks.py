@@ -125,6 +125,58 @@ class HttpPostSink(Sink):
         return len(rows)
 
 
+class ExternalFunctionSink(Sink):
+    """Per-row synchronous POST to an external function endpoint.
+
+    reference: AzureFunctionHandler.scala:14-75 — UDFs that POST to an
+    Azure Function per row (:47-66). TPU-native design keeps network
+    I/O out of the compiled graph, so external functions attach at the
+    output boundary: route a dataset to this sink (``OUTPUT Alerts TO
+    MyFn;``) and each row is sent as the function's payload. The
+    function definition comes from the same conf shape the reference
+    flattens (serviceEndpoint/api/code/methodType)."""
+
+    kind = "externalfn"
+
+    def __init__(
+        self,
+        endpoint: str,
+        api: str = "",
+        code: str = "",
+        method: str = "post",
+        timeout_s: float = 10.0,
+    ):
+        from urllib.parse import quote
+
+        url = endpoint.rstrip("/")
+        if api:
+            url += "/" + api.lstrip("/")
+        if code:
+            # function keys carry '+'/'=' — must be percent-encoded
+            url += ("&" if "?" in url else "?") + "code=" + quote(code, safe="")
+        self.url = url
+        self.method = method.upper()
+        self.timeout_s = timeout_s
+
+    def write(self, dataset, rows, batch_time_ms) -> int:
+        sent = 0
+        for r in rows:
+            req = urllib.request.Request(
+                self.url,
+                data=json.dumps(r, default=str).encode(),
+                headers={"Content-Type": "application/json"},
+                method=self.method,
+            )
+            try:
+                urllib.request.urlopen(req, timeout=self.timeout_s).read()
+                sent += 1
+            except Exception as e:  # noqa: BLE001 — per-row best effort
+                logger.warning(
+                    "external function call failed for %s: %s", dataset, e
+                )
+        return sent
+
+
 class MetricSink(Sink):
     """Routes a dataset's rows into the metrics pipeline.
 
@@ -200,6 +252,13 @@ def build_output_operators(
                 sinks.append(HttpPostSink(sconf.get_string("endpoint"), headers))
             elif sink_kind == "console":
                 sinks.append(ConsoleSink(sconf.get_int_option("maxrows") or 20))
+            elif sink_kind in ("externalfn", "azurefunction"):
+                sinks.append(ExternalFunctionSink(
+                    sconf.get_string("serviceendpoint"),
+                    api=sconf.get_or_else("api", ""),
+                    code=sconf.get_or_else("code", ""),
+                    method=sconf.get_or_else("methodtype", "post"),
+                ))
             elif sink_kind == "metric":
                 sinks.append(MetricSink(metric_logger))
             elif sink_kind == "eventhub":

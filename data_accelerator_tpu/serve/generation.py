@@ -329,6 +329,13 @@ class RuntimeConfigGeneration:
                         "connectionStringRef": props.get("connection", ""),
                         "compressionType": props.get("compressionType", "gzip"),
                     }
+                elif stype in ("externalfn", "azurefunction"):
+                    entry["externalfn"] = {
+                        "serviceEndpoint": props.get("serviceEndpoint", ""),
+                        "api": props.get("api", ""),
+                        "code": props.get("code", ""),
+                        "methodType": props.get("methodType", "post"),
+                    }
                 elif stype == "cosmosdb":
                     entry["cosmosdb"] = {
                         "connectionStringRef": props.get("connection", ""),

@@ -1,0 +1,232 @@
+"""UDF tier implementations: jax scalar UDFs, custom aggregates, Pallas
+kernels, and conf-driven loading.
+
+Contract with the expression compiler (compile/exprs.py:636): a UDF is
+an object with ``compile_call(compiler, func_ast) -> Value``; aggregate
+UDFs additionally set ``is_aggregate`` and provide ``reduce(arg_arrays,
+seg, capacity, valid_s)`` (consumed by the group-by planner). All device functions must be pure
+and traceable — per-batch refresh state arrives through ``on_interval``
+which triggers a step re-trace when it reports change (the reference's
+``DynamicUDF.onInterval`` refreshed broadcast variables the same way,
+ExtendedUDFHandler.scala:39 + CommonProcessorFactory.scala:351-353).
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import jax.numpy as jnp
+
+from ..core.config import EngineException, SettingDictionary
+
+logger = logging.getLogger(__name__)
+
+
+class JaxUdf:
+    """Scalar (row-wise) device UDF: ``fn(*arrays) -> array``.
+
+    ``out_type``: result type name, or callable(arg_types)->type.
+    ``on_interval``: optional ``fn(batch_time_ms) -> bool`` returning
+    True when captured state changed (forces step re-trace).
+    reference: DynamicUDF.Generator0..3 (arity implied by the SQL call).
+    """
+
+    is_aggregate = False
+
+    def __init__(
+        self,
+        name: str,
+        fn: Callable,
+        out_type: Union[str, Callable[[List[str]], str]] = "double",
+        on_interval: Optional[Callable[[int], bool]] = None,
+    ):
+        self.name = name
+        self.fn = fn
+        self.out_type = out_type
+        self._on_interval = on_interval
+
+    def on_interval(self, batch_time_ms: int) -> bool:
+        if self._on_interval is None:
+            return False
+        return bool(self._on_interval(batch_time_ms))
+
+    def compile_call(self, compiler, e):
+        from ..compile.exprs import CompiledExpr, is_device
+
+        args = [compiler.compile(a) for a in e.args]
+        bad = [a for a in args if not is_device(a)]
+        if bad:
+            raise EngineException(
+                f"UDF {self.name} requires device-typed arguments"
+            )
+        arg_types = [a.type for a in args]
+        out_t = (
+            self.out_type(arg_types) if callable(self.out_type) else self.out_type
+        )
+        fn = self.fn
+
+        def run(env):
+            return fn(*[a.fn(env) for a in args])
+
+        deps = tuple(d for a in args for d in a.deps)
+        return CompiledExpr(out_t, run, deps=deps)
+
+
+class JaxUdaf:
+    """Custom aggregate: reduces each sorted group segment to one value.
+
+    ``reduce(vals: [args x n], seg, capacity, valid_s) -> [capacity]``
+    where ``vals`` are the compiled argument arrays re-ordered into
+    group-sorted order. reference: UserDefinedAggregateFunction tier
+    (JarUDFHandler registerJavaUDAF, SparkJarLoader.scala:139-165).
+    """
+
+    is_aggregate = True
+
+    def __init__(
+        self,
+        name: str,
+        reduce: Callable,
+        out_type: Union[str, Callable[[List[str]], str]] = "double",
+    ):
+        self.name = name
+        self.reduce = reduce
+        self.out_type = out_type
+
+    def result_type(self, arg_types: List[str]) -> str:
+        return (
+            self.out_type(arg_types) if callable(self.out_type) else self.out_type
+        )
+
+    def on_interval(self, batch_time_ms: int) -> bool:
+        return False
+
+    def compile_call(self, compiler, e):
+        # non-grouped use: reduce over the whole (valid) batch is not
+        # supported yet — match the reference, where UDAFs appear with
+        # GROUP BY
+        raise EngineException(
+            f"aggregate UDF {self.name} requires a GROUP BY context"
+        )
+
+
+class PallasUdf(JaxUdf):
+    """JaxUdf whose body is a Pallas TPU kernel.
+
+    ``kernel(*refs)``: standard pallas kernel over 1-D row blocks; built
+    with interpret=True automatically off-TPU so the same flow runs on
+    the CPU one-box. The escape hatch the reference provides via custom
+    Scala UDFs compiled into the job JAR (datax-udf-samples/) — here the
+    user ships a Pallas kernel instead and keeps MXU/VPU control.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        kernel: Callable,
+        out_type: str = "double",
+        out_dtype=jnp.float32,
+        block_rows: int = 1024,
+        on_interval: Optional[Callable[[int], bool]] = None,
+    ):
+        self.kernel = kernel
+        self.out_dtype = out_dtype
+        self.block_rows = block_rows
+
+        def fn(*arrays):
+            return self._pallas_call(*arrays)
+
+        super().__init__(name, fn, out_type, on_interval)
+
+    def _pallas_call(self, *arrays):
+        import jax
+        from jax.experimental import pallas as pl
+
+        n = arrays[0].shape[0]
+        block = min(self.block_rows, n)
+        grid = (n + block - 1) // block
+        interpret = jax.default_backend() != "tpu"
+        return pl.pallas_call(
+            self.kernel,
+            out_shape=jax.ShapeDtypeStruct((n,), self.out_dtype),
+            grid=(grid,),
+            in_specs=[
+                pl.BlockSpec((block,), lambda i: (i,)) for _ in arrays
+            ],
+            out_specs=pl.BlockSpec((block,), lambda i: (i,)),
+            interpret=interpret,
+        )(*arrays)
+
+
+class UdfRegistry:
+    """name(lowercase) -> UDF object; the dict handed to FlowProcessor."""
+
+    def __init__(self, udfs: Optional[Dict[str, object]] = None):
+        self._udfs: Dict[str, object] = dict(udfs or {})
+
+    def register(self, udf) -> None:
+        self._udfs[udf.name.lower()] = udf
+
+    def as_dict(self) -> Dict[str, object]:
+        return dict(self._udfs)
+
+    def refresh(self, batch_time_ms: int) -> bool:
+        """Run every UDF's interval hook; True if any state changed
+        (caller re-traces the step). reference: udf.onInterval invocation
+        at CommonProcessorFactory.scala:351-353."""
+        changed = False
+        for udf in self._udfs.values():
+            hook = getattr(udf, "on_interval", None)
+            if hook is not None and hook(batch_time_ms):
+                changed = True
+        return changed
+
+
+def _import_attr(path: str):
+    """``package.module:attr`` -> python object (reflection-load analog,
+    ClassLoaderHost/SparkJarLoader)."""
+    if ":" in path:
+        mod_name, attr = path.split(":", 1)
+    else:
+        mod_name, attr = path.rsplit(".", 1)
+    mod = importlib.import_module(mod_name)
+    obj = mod
+    for part in attr.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def load_udfs_from_conf(dict_: SettingDictionary) -> Dict[str, object]:
+    """Load UDFs/UDAFs declared in job conf.
+
+    Conf shape (same namespaces the reference's flattener emits):
+      datax.job.process.jar.udf.<name>.class  = pkg.mod:attr
+      datax.job.process.jar.udaf.<name>.class = pkg.mod:attr
+    The attr is either a UDF object or a zero-arg factory returning one.
+    """
+    out: Dict[str, object] = {}
+    for tier in ("udf", "udaf"):
+        ns = f"datax.job.process.jar.{tier}."
+        grouped = dict_.get_sub_dictionary(ns).group_by_sub_namespace()
+        for name, sub in grouped.items():
+            cls_path = sub.get("class")
+            if not cls_path:
+                continue
+            try:
+                obj = _import_attr(cls_path)
+            except Exception as e:  # noqa: BLE001 — conf-driven load
+                raise EngineException(
+                    f"cannot load {tier} '{name}' from '{cls_path}': {e}"
+                ) from e
+            if isinstance(obj, type) or not hasattr(obj, "compile_call"):
+                obj = obj()  # class or factory -> instance
+            if not hasattr(obj, "compile_call"):
+                raise EngineException(
+                    f"{tier} '{name}' ({cls_path}) is not a UDF object"
+                )
+            obj.name = name
+            out[name.lower()] = obj
+            logger.info("registered %s %s from %s", tier, name, cls_path)
+    return out
