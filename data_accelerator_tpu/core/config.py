@@ -116,11 +116,22 @@ class SettingDictionary:
     def __len__(self) -> int:
         return len(self.elems)
 
+    @staticmethod
+    def _resolve(value: Optional[str]) -> Optional[str]:
+        """Transparent ``keyvault://vault/name`` resolution on read
+        (reference: KeyVaultClient.scala:108-125 resolveSecretIfAny is
+        applied to every config value the engine reads)."""
+        if value is None or "://" not in value:
+            return value
+        from .secrets import resolve_secret_if_any
+
+        return resolve_secret_if_any(value)
+
     def get(self, key: str) -> Optional[str]:
-        return self.elems.get(key)
+        return self._resolve(self.elems.get(key))
 
     def get_default(self) -> Optional[str]:
-        return self.elems.get(SettingNamespace.DefaultSettingName)
+        return self._resolve(self.elems.get(SettingNamespace.DefaultSettingName))
 
     def _get_or_throw(self, value: Optional[T], key: str) -> T:
         if value is None:
@@ -130,10 +141,11 @@ class SettingDictionary:
         return value
 
     def get_string(self, key: str) -> str:
-        return self._get_or_throw(self.elems.get(key), key)
+        return self._get_or_throw(self._resolve(self.elems.get(key)), key)
 
     def get_or_else(self, key: str, default: Optional[str]) -> Optional[str]:
-        return self.elems.get(key, default)
+        v = self._resolve(self.elems.get(key))
+        return default if v is None else v
 
     def get_int_option(self, key: str) -> Optional[int]:
         v = self.elems.get(key)

@@ -19,6 +19,7 @@ from typing import Dict, Optional
 
 from ..core.config import SettingDictionary, SettingNamespace
 from ..core.confmanager import ConfigManager
+from ..obs import telemetry
 from ..obs.metrics import MetricLogger
 from .checkpoint import OffsetCheckpointer
 from .processor import FlowProcessor
@@ -39,6 +40,9 @@ class StreamingHost:
         self.dict = dict_
         self.processor = FlowProcessor(dict_, udfs=udfs)
         self.metric_logger = MetricLogger.from_conf(dict_)
+        # lifecycle telemetry (AppInsightLogger analog): batch begin/end
+        # events + exceptions with app context (AppInsightLogger.scala:18-108)
+        self.telemetry = telemetry.from_conf(dict_)
 
         input_conf = dict_.get_sub_dictionary(SettingNamespace.JobInputPrefix)
         self.source = source or make_source(input_conf, self.processor.input_schema)
@@ -98,16 +102,23 @@ class StreamingHost:
             rows, consumed = self.source.poll(max_events)
             raw = self.processor.encode_rows(rows, (batch_time_ms // 1000) * 1000)
 
+        self.telemetry.batch_begin(batch_time_ms)
         try:
             datasets, metrics = self.processor.process_batch(raw, batch_time_ms)
             self.dispatcher.dispatch(datasets, batch_time_ms)
             self.processor.commit()
             self.source.ack()
-        except Exception:
+        except Exception as e:
+            # log + rethrow so the batch retries, at-least-once
+            # (CommonProcessorFactory.scala:382-398)
+            self.telemetry.track_exception(
+                e, {"event": "error/streaming/process", "batchTime": batch_time_ms}
+            )
             logger.exception("batch processing failed; rethrowing for retry")
             raise
 
         metrics["Latency-Batch"] = (time.time() - t0) * 1000.0
+        self.telemetry.batch_end(batch_time_ms, {"latencyMs": metrics["Latency-Batch"]})
         self.metric_logger.send_batch_metrics(metrics, batch_time_ms)
         logger.info(
             "batch %d: %s",

@@ -30,6 +30,7 @@ from typing import Callable, Dict, List, Optional
 from ..core.config import SettingDictionary
 from ..obs.metrics import MetricLogger
 from ..constants import MetricName
+from ..utils import fs
 
 logger = logging.getLogger(__name__)
 
@@ -83,20 +84,12 @@ class FileSink(Sink):
         if not rows:
             return 0
         out_dir = partition_folder(self.folder, batch_time_ms)
-        os.makedirs(out_dir, exist_ok=True)
         self._counter += 1
         ext = ".json.gz" if self.compression == "gzip" else ".json"
         name = f"{dataset}_{batch_time_ms}_{self._counter}{ext}"
         path = os.path.join(out_dir, name)
         payload = "\n".join(json.dumps(r, default=str) for r in rows) + "\n"
-        tmp = path + ".tmp"
-        if self.compression == "gzip":
-            with gzip.open(tmp, "wt", encoding="utf-8") as f:
-                f.write(payload)
-        else:
-            with open(tmp, "w", encoding="utf-8") as f:
-                f.write(payload)
-        os.replace(tmp, path)
+        fs.write_text(path, payload)
         return len(rows)
 
 

@@ -216,12 +216,12 @@ def load_udfs_from_conf(dict_: SettingDictionary) -> Dict[str, object]:
                 continue
             try:
                 obj = _import_attr(cls_path)
+                if isinstance(obj, type) or not hasattr(obj, "compile_call"):
+                    obj = obj()  # class or factory -> instance
             except Exception as e:  # noqa: BLE001 — conf-driven load
                 raise EngineException(
                     f"cannot load {tier} '{name}' from '{cls_path}': {e}"
                 ) from e
-            if isinstance(obj, type) or not hasattr(obj, "compile_call"):
-                obj = obj()  # class or factory -> instance
             if not hasattr(obj, "compile_call"):
                 raise EngineException(
                     f"{tier} '{name}' ({cls_path}) is not a UDF object"

@@ -1,0 +1,80 @@
+"""Tests for the telemetry module (AppInsightLogger analog)."""
+
+import json
+
+from data_accelerator_tpu.core.config import SettingDictionary
+from data_accelerator_tpu.obs import telemetry
+
+
+class CaptureWriter(telemetry.TelemetryWriter):
+    def __init__(self):
+        self.records = []
+
+    def write(self, record):
+        self.records.append(record)
+
+
+def test_event_carries_context():
+    w = CaptureWriter()
+    t = telemetry.TelemetryLogger("DATAX-Flow1", [w], {"role": "driver"})
+    t.track_event("streaming/batch/begin", {"batchTime": 123})
+    (r,) = w.records
+    assert r["type"] == "event"
+    assert r["name"] == "streaming/batch/begin"
+    assert r["app"] == "DATAX-Flow1"
+    assert r["role"] == "driver"
+    assert r["properties"]["batchTime"] == 123
+    assert "ts" in r
+
+
+def test_with_context_derivation():
+    w = CaptureWriter()
+    t = telemetry.TelemetryLogger("app", [w]).with_context(executor="e1")
+    t.track_metric("Latency-Batch", 12.5)
+    assert w.records[0]["executor"] == "e1"
+    assert w.records[0]["value"] == 12.5
+
+
+def test_exception_record():
+    w = CaptureWriter()
+    t = telemetry.TelemetryLogger("app", [w])
+    try:
+        raise ValueError("boom")
+    except ValueError as e:
+        t.track_exception(e, {"event": "error/streaming/process"})
+    (r,) = w.records
+    assert r["type"] == "exception"
+    assert "ValueError: boom" in r["error"]
+    assert r["properties"]["event"] == "error/streaming/process"
+
+
+def test_writer_failure_never_raises():
+    class Bad(telemetry.TelemetryWriter):
+        def write(self, record):
+            raise RuntimeError("writer down")
+
+    t = telemetry.TelemetryLogger("app", [Bad()])
+    t.track_event("x")  # must not raise
+
+
+def test_jsonl_writer_appends(tmp_path):
+    p = str(tmp_path / "trace" / "t.jsonl")
+    t = telemetry.TelemetryLogger("app", [telemetry.JsonlWriter(p)])
+    t.batch_begin(1000)
+    t.batch_end(1000, {"latencyMs": 5.0})
+    lines = [json.loads(x) for x in open(p).read().splitlines()]
+    assert [r["name"] for r in lines] == [
+        "streaming/batch/begin", "streaming/batch/end"
+    ]
+    assert lines[1]["measurements"]["latencyMs"] == 5.0
+
+
+def test_from_conf_builds_writers(tmp_path):
+    d = SettingDictionary({
+        "datax.job.name": "Flow2",
+        "datax.job.process.telemetry.tracefile": str(tmp_path / "t.jsonl"),
+    })
+    t = telemetry.from_conf(d)
+    kinds = {type(w).__name__ for w in t.writers}
+    assert kinds == {"LogWriter", "JsonlWriter"}
+    assert t.app_name.endswith("Flow2")
