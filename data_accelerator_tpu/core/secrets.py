@@ -36,8 +36,10 @@ class SecretVault:
     """Resolves secret URIs from env overlay + local vault files."""
 
     def __init__(self, vault_dir: Optional[str] = None):
+        # default under $HOME, not /tmp: a world-writable default dir
+        # would let any local user pre-seed secrets the config resolves
         self.vault_dir = vault_dir or os.environ.get(
-            DEFAULT_VAULT_DIR_ENV, "/tmp/dxtpu-vault"
+            DEFAULT_VAULT_DIR_ENV, os.path.expanduser("~/.dxtpu/vault")
         )
         self._cache: Dict[str, Dict[str, str]] = {}
         self._lock = threading.Lock()
@@ -74,12 +76,20 @@ class SecretVault:
 
     def set_secret(self, vault: str, name: str, value: str) -> str:
         """Write-through to the vault file; returns the canonical URI
-        (the config-gen side mints URIs this way, DataX.Config.KeyVault)."""
-        os.makedirs(self.vault_dir, exist_ok=True)
+        (the config-gen side mints URIs this way, DataX.Config.KeyVault).
+
+        The vault dir/file get owner-only permissions — the local-file
+        vault is only as private as its mode."""
+        os.makedirs(self.vault_dir, mode=0o700, exist_ok=True)
+        try:
+            os.chmod(self.vault_dir, 0o700)
+        except OSError:
+            pass
         path = os.path.join(self.vault_dir, f"{vault}.json")
         data = dict(self._load_vault(vault))
         data[name] = value
-        with open(path, "w", encoding="utf-8") as f:
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
             json.dump(data, f, indent=1, sort_keys=True)
         with self._lock:
             self._cache[vault] = data

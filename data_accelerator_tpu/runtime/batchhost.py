@@ -1,0 +1,228 @@
+"""BatchHost: one-shot / scheduled batch jobs over time-partitioned files.
+
+reference: datax-host host/BlobBatchingHost.scala:25-110 — expands a
+``{yyyy-MM-dd}``-style datetime pattern in the input path over
+[startTime, endTime] stepping by partitionIncrement minutes (:28-53),
+lists matching files, and runs the processor once over the whole file
+set (``BatchApp.scala:10`` entry; batch conf read by
+BatchBlobInputSetting from ``datax.job.input.batch.blob.<i>.*``).
+
+TPU flavor: files are read host-side (gzip-aware), decoded into
+fixed-capacity device batches, and pushed through the same compiled
+FlowProcessor step the streaming path uses — one engine, two drivers.
+A processed-files tracker makes recurring runs idempotent (the
+reference gets this by scheduling disjoint [start, end) windows;
+we keep that *and* tolerate overlap).
+
+Run: ``python -m data_accelerator_tpu.runtime.batchhost conf=<flow>.conf``
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import sys
+import time
+from datetime import datetime, timedelta, timezone
+from typing import Dict, List, Optional, Tuple
+
+from ..core.config import SettingDictionary
+from ..core.confmanager import ConfigManager
+from ..obs import telemetry
+from ..obs.metrics import MetricLogger
+from ..utils import fs
+from .processor import FlowProcessor
+from .sinks import OutputDispatcher, build_output_operators
+from .sources import read_json_file
+
+logger = logging.getLogger(__name__)
+
+# the reference accepts one datetime token of y/M/d/H/m/s/S with -/. or /
+# separators (BlobBatchingHost.scala getDateTimePattern)
+_DATETIME_TOKEN_RE = re.compile(r"\{([yMdHmsS\-/.]+)\}")
+
+_JAVA_TO_STRFTIME = [
+    ("yyyy", "%Y"), ("MM", "%m"), ("dd", "%d"),
+    ("HH", "%H"), ("mm", "%M"), ("ss", "%S"),
+]
+
+
+def _format_java(fmt: str, t: datetime) -> str:
+    for java, py in _JAVA_TO_STRFTIME:
+        fmt = fmt.replace(java, py)
+    return t.strftime(fmt)
+
+
+def get_input_blob_path_prefixes(
+    path: str,
+    start_time: datetime,
+    processing_window_s: float,
+    partition_increment_s: float,
+) -> List[Tuple[str, datetime]]:
+    """Expand the datetime token over the window, deduping partitions.
+
+    reference: BlobBatchingHost.scala:28-53 getInputBlobPathPrefixes —
+    walks t from 0..window stepping by the increment, substitutes the
+    formatted partition folder, skips duplicates; a pattern-less path
+    passes through unchanged.
+    """
+    m = _DATETIME_TOKEN_RE.search(path)
+    if not m:
+        logger.warning("input path has no datetime pattern: %s", path)
+        return [(path, datetime.now(timezone.utc))]
+    fmt = m.group(1)
+    out: List[Tuple[str, datetime]] = []
+    seen = set()
+    t = 0.0
+    while t <= processing_window_s:
+        ts = start_time + timedelta(seconds=t)
+        folder = _format_java(fmt, ts)
+        if folder not in seen:
+            seen.add(folder)
+            out.append((path.replace("{" + fmt + "}", folder), ts))
+        t += partition_increment_s
+    return out
+
+
+def get_batch_blobs_conf(dict_: SettingDictionary) -> List[Dict[str, str]]:
+    """Read ``datax.job.input.batch.blob.<i>.*`` entries
+    (reference: BatchBlobInputSetting.getInputBlobsArrayConf)."""
+    sub = dict_.get_sub_dictionary("datax.job.input.batch.blob.")
+    grouped = sub.group_by_sub_namespace()
+    out = []
+    for idx in sorted(grouped, key=lambda s: int(s) if s.isdigit() else 0):
+        g = grouped[idx]
+        out.append({
+            "path": g.get_or_else("path", ""),
+            "starttime": g.get_or_else("starttime", ""),
+            "endtime": g.get_or_else("endtime", ""),
+            "partitionincrement": g.get_or_else("partitionincrement", "1"),
+        })
+    return out
+
+
+def _parse_iso(ts: str) -> datetime:
+    t = datetime.fromisoformat(ts.replace("Z", "+00:00"))
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=timezone.utc)
+    return t
+
+
+class BatchHost:
+    """Drives one batch run: expand prefixes -> list -> process -> sink."""
+
+    def __init__(
+        self,
+        dict_: SettingDictionary,
+        udfs: Optional[dict] = None,
+        table_sink_map: Optional[Dict[str, list]] = None,
+        tracker_path: Optional[str] = None,
+    ):
+        self.dict = dict_
+        self.processor = FlowProcessor(dict_, udfs=udfs)
+        self.metric_logger = MetricLogger.from_conf(dict_)
+        self.telemetry = telemetry.from_conf(dict_)
+        if table_sink_map is None:
+            from ..core.config import SettingNamespace
+
+            conf_outputs = dict_.get_sub_dictionary(
+                SettingNamespace.JobOutputPrefix
+            ).group_by_sub_namespace()
+            table_sink_map = {name: [name] for name in conf_outputs}
+        self.dispatcher = OutputDispatcher(
+            build_output_operators(dict_, self.metric_logger, table_sink_map),
+            self.metric_logger,
+        )
+        self.tracker_path = tracker_path or dict_.get(
+            "datax.job.input.batch.blob.trackerfile"
+        )
+        self._processed: set = set()
+        if self.tracker_path:
+            try:
+                self._processed = set(fs.read_lines(self.tracker_path))
+            except FileNotFoundError:
+                pass
+
+    def list_files_to_process(self) -> List[str]:
+        blobs = get_batch_blobs_conf(self.dict)
+        files: List[str] = []
+        for b in blobs:
+            if not b["path"]:
+                continue
+            if b["starttime"] and b["endtime"]:
+                start = _parse_iso(b["starttime"])
+                end = _parse_iso(b["endtime"])
+                window_s = (end - start).total_seconds()
+                incr_s = float(b["partitionincrement"]) * 60.0
+                if incr_s <= 0:
+                    raise ValueError(
+                        "datax.job.input.batch.blob partitionincrement "
+                        f"must be positive, got {b['partitionincrement']!r}"
+                    )
+                prefixes = get_input_blob_path_prefixes(
+                    b["path"], start, window_s, incr_s
+                )
+            else:
+                prefixes = [(b["path"], datetime.now(timezone.utc))]
+            for prefix, _ts in prefixes:
+                files.extend(fs.list_files(prefix))
+        return [f for f in sorted(set(files)) if f not in self._processed]
+
+    def run(self) -> Dict[str, float]:
+        """Process all pending files in capacity-sized device batches.
+
+        reference: BlobBatchingHost.runBatchApp:70-110 — one processor
+        pass over the listed files; here the fixed device batch shape
+        chunks the row stream, same compiled step per chunk.
+        """
+        self.telemetry.track_event("datax/batch/app/begin")
+        t0 = time.time()
+        files = self.list_files_to_process()
+        cap = self.processor.batch_capacity
+        totals: Dict[str, float] = {"Batch_Files_Count": float(len(files))}
+        rows: List[dict] = []
+        batch_time_ms = int(t0 * 1000)
+
+        def flush(chunk: List[dict]):
+            raw = self.processor.encode_rows(chunk, (batch_time_ms // 1000) * 1000)
+            datasets, metrics = self.processor.process_batch(raw, batch_time_ms)
+            self.dispatcher.dispatch(datasets, batch_time_ms)
+            self.processor.commit()
+            for k, v in metrics.items():
+                totals[k] = totals.get(k, 0.0) + float(v)
+
+        try:
+            for f in files:
+                rows.extend(read_json_file(f))
+                while len(rows) >= cap:
+                    flush(rows[:cap])
+                    rows = rows[cap:]
+            if rows:
+                flush(rows)
+        except Exception as e:
+            self.telemetry.track_exception(e, {"event": "error/batch/process"})
+            raise
+        # tracker written only after a fully successful pass (at-least-once)
+        self._processed.update(files)
+        if self.tracker_path:
+            fs.write_text(self.tracker_path, "\n".join(sorted(self._processed)) + "\n")
+        totals["Latency-Batch"] = (time.time() - t0) * 1000.0
+        self.metric_logger.send_batch_metrics(totals, batch_time_ms)
+        self.telemetry.track_event(
+            "datax/batch/end", measurements={k: float(v) for k, v in totals.items()}
+        )
+        logger.info("batch run done: %s", totals)
+        return totals
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    args = argv if argv is not None else sys.argv[1:]
+    ConfigManager.reset()
+    ConfigManager.get_configuration_from_arguments(args)
+    d = ConfigManager.load_config()
+    BatchHost(d).run()
+
+
+if __name__ == "__main__":
+    main()

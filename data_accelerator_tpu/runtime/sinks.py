@@ -17,7 +17,6 @@ happens once per batch in the processor, off the jitted path.
 
 from __future__ import annotations
 
-import gzip
 import json
 import logging
 import os
@@ -170,6 +169,177 @@ class ExternalFunctionSink(Sink):
         return sent
 
 
+class SqlSink(Sink):
+    """Relational sink: per-batch inserts with append/overwrite modes.
+
+    reference: sink/SqlSinker.scala:15-106 — DataFrame writes to SQL
+    Server via JDBC/connector/bulk-copy with a configured ``table`` and
+    ``writeMode``. TPU-native one-box analog: sqlite3 (stdlib DB-API);
+    any DB-API driver slots in behind the same conf
+    (``output.<name>.sql.{connectionstring,table,writemode}``). Column
+    DDL is inferred from the first batch's row shape.
+    """
+
+    kind = "sql"
+
+    def __init__(self, connection_string: str, table: str, write_mode: str = "append"):
+        # "jdbc:sqlite:/path/db" or a bare path both work
+        self.db_path = connection_string.split(":", 2)[-1] if \
+            connection_string.startswith("jdbc:") else connection_string
+        self.table = table
+        self.write_mode = write_mode.lower()
+        self._initialized = False
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _sql_type(v) -> str:
+        if isinstance(v, bool):
+            return "INTEGER"
+        if isinstance(v, int):
+            return "INTEGER"
+        if isinstance(v, float):
+            return "REAL"
+        return "TEXT"
+
+    def write(self, dataset, rows, batch_time_ms) -> int:
+        if not rows:
+            return 0
+        import sqlite3
+
+        fs.ensure_parent_dir(self.db_path)
+        # union of keys across the batch: later rows may carry extra
+        # columns, and later batches may evolve the shape
+        cols: List[str] = []
+        for r in rows:
+            for c in r:
+                if c not in cols:
+                    cols.append(c)
+        sample = {c: next((r[c] for r in rows if c in r), None) for c in cols}
+        with self._lock:
+            conn = sqlite3.connect(self.db_path, timeout=30)
+            try:
+                cur = conn.cursor()
+                if not self._initialized:
+                    if self.write_mode == "overwrite":
+                        cur.execute(f'DROP TABLE IF EXISTS "{self.table}"')
+                    ddl = ", ".join(
+                        f'"{c}" {self._sql_type(sample[c])}' for c in cols
+                    )
+                    cur.execute(
+                        f'CREATE TABLE IF NOT EXISTS "{self.table}" ({ddl})'
+                    )
+                    self._initialized = True
+                existing = {
+                    r[1] for r in cur.execute(
+                        f'PRAGMA table_info("{self.table}")'
+                    ).fetchall()
+                }
+                for c in cols:
+                    if c not in existing:
+                        cur.execute(
+                            f'ALTER TABLE "{self.table}" ADD COLUMN '
+                            f'"{c}" {self._sql_type(sample[c])}'
+                        )
+                placeholders = ", ".join("?" for _ in cols)
+                quoted = ", ".join(f'"{c}"' for c in cols)
+                cur.executemany(
+                    f'INSERT INTO "{self.table}" ({quoted}) VALUES ({placeholders})',
+                    [
+                        tuple(
+                            r.get(c) if isinstance(
+                                r.get(c), (int, float, str, bytes, type(None))
+                            ) else json.dumps(r.get(c), default=str)
+                            for c in cols
+                        )
+                        for r in rows
+                    ],
+                )
+                conn.commit()
+            finally:
+                conn.close()
+        return len(rows)
+
+
+class DocumentSink(Sink):
+    """Document-store sink: per-row document create with generated ids.
+
+    reference: sink/CosmosDBSinker.scala:19-140 — a DocumentClient pool
+    per partition creating one document per row in ``db/collection``.
+    One-box analog: an append-only JSONL document log per collection
+    under ``<root>/<db>/<collection>/docs.jsonl``, each row gaining a
+    GUID ``id`` like Cosmos assigns; a cloud document client slots in
+    behind the same conf (``output.<name>.cosmosdb.{connectionstring,
+    database,collection}``).
+    """
+
+    kind = "cosmosdb"
+
+    def __init__(self, root: str, database: str, collection: str):
+        self.dir = os.path.join(root, database, collection)
+        self._lock = threading.Lock()
+
+    def write(self, dataset, rows, batch_time_ms) -> int:
+        if not rows:
+            return 0
+        import uuid
+
+        os.makedirs(self.dir, exist_ok=True)
+        path = os.path.join(self.dir, "docs.jsonl")
+        with self._lock:
+            with open(path, "a", encoding="utf-8") as f:
+                for r in rows:
+                    doc = {"id": str(uuid.uuid4()), **r}
+                    f.write(json.dumps(doc, default=str) + "\n")
+        return len(rows)
+
+
+class StreamSink(Sink):
+    """Event-stream sink: newline-delimited JSON over TCP.
+
+    reference: sink/EventHubStreamPoster.scala:15-81 — per-row JSON
+    posts into an EventHub. TPU-native analog: the DCN egress path is a
+    TCP stream in the same wire format SocketSource ingests, so one
+    flow's output can feed another's input (EventHub's role between
+    chained flows). Reconnects lazily; failures raise so the batch
+    retries rather than silently dropping (at-least-once).
+    """
+
+    kind = "eventhub"
+
+    def __init__(self, host: str, port: int):
+        self.addr = (host, port)
+        self._sock = None
+        self._lock = threading.Lock()
+
+    def _connect(self):
+        import socket as _socket
+
+        s = _socket.create_connection(self.addr, timeout=10)
+        return s
+
+    def write(self, dataset, rows, batch_time_ms) -> int:
+        if not rows:
+            return 0
+        payload = b"".join(
+            json.dumps(r, default=str).encode() + b"\n" for r in rows
+        )
+        with self._lock:
+            try:
+                if self._sock is None:
+                    self._sock = self._connect()
+                self._sock.sendall(payload)
+            except OSError:
+                # one reconnect attempt, then propagate for batch retry
+                try:
+                    if self._sock is not None:
+                        self._sock.close()
+                except OSError:
+                    pass
+                self._sock = self._connect()
+                self._sock.sendall(payload)
+        return len(rows)
+
+
 class MetricSink(Sink):
     """Routes a dataset's rows into the metrics pipeline.
 
@@ -254,11 +424,32 @@ def build_output_operators(
                 ))
             elif sink_kind == "metric":
                 sinks.append(MetricSink(metric_logger))
-            elif sink_kind == "eventhub":
-                logger.warning(
-                    "eventhub sink for output %s stubbed to file sink", out_name
-                )
-                sinks.append(FileSink(f"/tmp/dxtpu-out/{out_name}", "gzip"))
+            elif sink_kind == "sql":
+                sinks.append(SqlSink(
+                    sconf.get_string("connectionstring"),
+                    sconf.get_or_else("table", out_name),
+                    sconf.get_or_else("writemode", "append"),
+                ))
+            elif sink_kind in ("cosmosdb", "document"):
+                sinks.append(DocumentSink(
+                    sconf.get_or_else("connectionstring", "/tmp/dxtpu-docs"),
+                    sconf.get_or_else("database", "db"),
+                    sconf.get_or_else("collection", out_name),
+                ))
+            elif sink_kind in ("eventhub", "stream"):
+                # connection "host:port" (EventHub conn-string role); any
+                # other shape (e.g. an sb:// conn string from a reference
+                # conf) degrades to a file sink like one-box
+                conn = sconf.get("connectionstring") or ""
+                h, _, p = conn.rpartition(":")
+                if p.isdigit():
+                    sinks.append(StreamSink(h or "127.0.0.1", int(p)))
+                else:
+                    logger.warning(
+                        "eventhub sink for output %s has no host:port; "
+                        "writing to file sink instead", out_name,
+                    )
+                    sinks.append(FileSink(f"/tmp/dxtpu-out/{out_name}", "gzip"))
         if not sinks and out_name.lower() == "metrics":
             sinks.append(MetricSink(metric_logger))
         named_sinks[out_name] = sinks
