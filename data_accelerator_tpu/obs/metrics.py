@@ -39,11 +39,21 @@ class MetricLogger:
     @staticmethod
     def from_conf(dict_: SettingDictionary) -> "MetricLogger":
         """reference: MetricsHandler.scala:12-35 reads
-        process.metric.{redis,eventhub,httppost}."""
+        process.metric.{redis,eventhub,httppost}. An ``eventhub`` value
+        of ``host:port`` ships points to a MetricsIngestor side-car over
+        TCP (the metrics-EventHub path, MetricLogger.scala:60-63)."""
         sub = dict_.get_sub_dictionary("datax.job.process.metric.")
+        eventhub_sender = None
+        conn = sub.get("eventhub") or ""
+        h, _, p = conn.rpartition(":")
+        if p.isdigit():
+            from .ingestor import MetricStreamSender
+
+            eventhub_sender = MetricStreamSender(h or "127.0.0.1", int(p))
         return MetricLogger(
             metric_app_name=dict_.get_metric_app_name(),
             http_endpoint=sub.get("httppost"),
+            eventhub_sender=eventhub_sender,
         )
 
     def key(self, metric: str) -> str:

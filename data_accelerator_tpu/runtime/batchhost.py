@@ -41,16 +41,12 @@ logger = logging.getLogger(__name__)
 # separators (BlobBatchingHost.scala getDateTimePattern)
 _DATETIME_TOKEN_RE = re.compile(r"\{([yMdHmsS\-/.]+)\}")
 
-_JAVA_TO_STRFTIME = [
-    ("yyyy", "%Y"), ("MM", "%m"), ("dd", "%d"),
-    ("HH", "%H"), ("mm", "%M"), ("ss", "%S"),
-]
-
 
 def _format_java(fmt: str, t: datetime) -> str:
-    for java, py in _JAVA_TO_STRFTIME:
-        fmt = fmt.replace(java, py)
-    return t.strftime(fmt)
+    # single java-format token table lives in sources (the fs/ingest side)
+    from .sources import _java_fmt_to_strftime
+
+    return t.strftime(_java_fmt_to_strftime(fmt))
 
 
 def get_input_blob_path_prefixes(

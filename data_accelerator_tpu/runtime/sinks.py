@@ -201,6 +201,12 @@ class SqlSink(Sink):
             return "REAL"
         return "TEXT"
 
+    @staticmethod
+    def _q(identifier: str) -> str:
+        """Quote an identifier, escaping embedded quotes — column names
+        come from row keys, i.e. from data."""
+        return '"' + identifier.replace('"', '""') + '"'
+
     def write(self, dataset, rows, batch_time_ms) -> int:
         if not rows:
             return 0
@@ -219,31 +225,32 @@ class SqlSink(Sink):
             conn = sqlite3.connect(self.db_path, timeout=30)
             try:
                 cur = conn.cursor()
+                tq = self._q(self.table)
                 if not self._initialized:
                     if self.write_mode == "overwrite":
-                        cur.execute(f'DROP TABLE IF EXISTS "{self.table}"')
+                        cur.execute(f'DROP TABLE IF EXISTS {tq}')
                     ddl = ", ".join(
-                        f'"{c}" {self._sql_type(sample[c])}' for c in cols
+                        f'{self._q(c)} {self._sql_type(sample[c])}' for c in cols
                     )
                     cur.execute(
-                        f'CREATE TABLE IF NOT EXISTS "{self.table}" ({ddl})'
+                        f'CREATE TABLE IF NOT EXISTS {tq} ({ddl})'
                     )
                     self._initialized = True
                 existing = {
                     r[1] for r in cur.execute(
-                        f'PRAGMA table_info("{self.table}")'
+                        f'PRAGMA table_info({tq})'
                     ).fetchall()
                 }
                 for c in cols:
                     if c not in existing:
                         cur.execute(
-                            f'ALTER TABLE "{self.table}" ADD COLUMN '
-                            f'"{c}" {self._sql_type(sample[c])}'
+                            f'ALTER TABLE {tq} ADD COLUMN '
+                            f'{self._q(c)} {self._sql_type(sample[c])}'
                         )
                 placeholders = ", ".join("?" for _ in cols)
-                quoted = ", ".join(f'"{c}"' for c in cols)
+                quoted = ", ".join(self._q(c) for c in cols)
                 cur.executemany(
-                    f'INSERT INTO "{self.table}" ({quoted}) VALUES ({placeholders})',
+                    f'INSERT INTO {tq} ({quoted}) VALUES ({placeholders})',
                     [
                         tuple(
                             r.get(c) if isinstance(

@@ -88,7 +88,8 @@ class LocalSource(StreamingSource):
 _TIME_TOKEN_RE = re.compile(r"\{([^}]+)\}")
 
 _FMT_MAP = [
-    ("yyyy", "%Y"), ("MM", "%m"), ("dd", "%d"), ("HH", "%H"), ("mm", "%M"),
+    ("yyyy", "%Y"), ("MM", "%m"), ("dd", "%d"),
+    ("HH", "%H"), ("mm", "%M"), ("ss", "%S"),
 ]
 
 
@@ -320,9 +321,11 @@ class BlobPointerSource(StreamingSource):
                 t = datetime.strptime(text, _java_fmt_to_strftime(self.file_time_format))
             else:
                 # reference: Timestamp.valueOf(str.replace('_',':').replace('T',' '))
-                t = datetime.fromisoformat(
-                    text.replace("_", ":").replace(" ", "T")
-                )
+                # — but normalize the date/time separator first so paths
+                # like 2024-03-01_12_30_00 parse (the default regex
+                # accepts T/_/space there)
+                iso = text[:10] + "T" + text[11:].replace("_", ":")
+                t = datetime.fromisoformat(iso)
             if t.tzinfo is None:
                 t = t.replace(tzinfo=timezone.utc)
             return int(t.timestamp() * 1000)

@@ -180,7 +180,11 @@ class DataXApi:
         return self.flow_ops.schedule_batch(self._flow_name(body, query))
 
     def _flow_delete(self, body, query):
-        return {"deleted": self.flow_ops.delete_flow(self._flow_name(body, query))}
+        """Cascade delete incl. the flow's live kernels
+        (DataX.Flow.DeleteHelper deletes configs/checkpoints/kernels)."""
+        name = self._flow_name(body, query)
+        self.kernels.delete_kernels(name)
+        return {"deleted": self.flow_ops.delete_flow(name)}
 
     def _flow_get(self, body, query):
         doc = self.flow_ops.get_flow(self._flow_name(body, query))

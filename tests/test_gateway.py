@@ -1,0 +1,135 @@
+"""Tests for the gateway reverse proxy (DataX.Gateway analog): auth,
+role enforcement, header minting, forwarding."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from data_accelerator_tpu.serve.flowservice import FlowOperation
+from data_accelerator_tpu.serve.gateway import (
+    ROLE_READER,
+    ROLE_WRITER,
+    AuthTable,
+    Gateway,
+)
+from data_accelerator_tpu.serve.restapi import DataXApi, DataXApiService
+from data_accelerator_tpu.serve.storage import (
+    LocalDesignTimeStorage,
+    LocalRuntimeStorage,
+)
+
+
+@pytest.fixture()
+def backend(tmp_path):
+    ops = FlowOperation(
+        LocalDesignTimeStorage(str(tmp_path / "design")),
+        LocalRuntimeStorage(str(tmp_path / "runtime")),
+    )
+    svc = DataXApiService(
+        DataXApi(ops, require_roles=True), port=0
+    )
+    svc.start()
+    yield svc
+    svc.stop()
+
+
+@pytest.fixture()
+def gateway(backend):
+    auth = AuthTable()
+    auth.add("rtoken", "reader@contoso", [ROLE_READER])
+    auth.add("wtoken", "writer@contoso", [ROLE_READER, ROLE_WRITER])
+    auth.add("banned", "evil@contoso", [ROLE_WRITER])
+    gw = Gateway(
+        auth,
+        backends={"flow": f"http://127.0.0.1:{backend.port}"},
+        port=0,
+        whitelist=["reader@contoso", "writer@contoso"],
+    )
+    gw.start()
+    yield gw
+    gw.stop()
+
+
+def _call(gw, method, path, token=None, body=None, headers=None):
+    url = f"http://127.0.0.1:{gw.port}{path}"
+    hdrs = dict(headers or {})
+    if token:
+        hdrs["Authorization"] = f"Bearer {token}"
+    data = json.dumps(body).encode() if body is not None else None
+    if data is not None:
+        hdrs["Content-Type"] = "application/json"
+    req = urllib.request.Request(url, data=data, headers=hdrs, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_unauthenticated_401(gateway):
+    status, payload = _call(gateway, "GET", "/api/flow/flow/getall")
+    assert status == 401
+
+
+def test_reader_can_get_writer_required_for_post(gateway):
+    status, payload = _call(
+        gateway, "GET", "/api/flow/flow/getall", token="rtoken"
+    )
+    assert status == 200
+    status, _ = _call(
+        gateway, "POST", "/api/flow/flow/save", token="rtoken",
+        body={"name": "f1"},
+    )
+    assert status == 403
+    status, _ = _call(
+        gateway, "POST", "/api/flow/flow/save", token="wtoken",
+        body={"name": "f1", "displayName": "F1"},
+    )
+    assert status == 200
+
+
+def test_whitelist_blocks_even_with_role(gateway):
+    status, payload = _call(
+        gateway, "GET", "/api/flow/flow/getall", token="banned"
+    )
+    assert status == 403
+    assert "whitelisted" in payload["error"]["message"]
+
+
+def test_caller_supplied_role_headers_stripped(gateway):
+    """A caller can't smuggle roles past the gateway — it mints
+    X-DataX-Roles itself (GatewayController.cs:178-208)."""
+    status, _ = _call(
+        gateway, "POST", "/api/flow/flow/save", token="rtoken",
+        body={"name": "f2"},
+        headers={"X-DataX-Roles": ROLE_WRITER},
+    )
+    assert status == 403
+
+
+def test_unknown_service_404(gateway):
+    status, payload = _call(gateway, "GET", "/api/nope/x", token="rtoken")
+    assert status == 404
+
+
+def test_backend_unreachable_502():
+    auth = AuthTable({"t": ("u", [ROLE_READER])})
+    gw = Gateway(auth, backends={"flow": "http://127.0.0.1:1"}, port=0)
+    gw.start()
+    try:
+        status, payload = _call(gw, "GET", "/api/flow/flow/getall", token="t")
+        assert status == 502
+    finally:
+        gw.stop()
+
+
+def test_auth_table_from_file(tmp_path):
+    p = tmp_path / "auth.json"
+    p.write_text(json.dumps({
+        "tok1": {"user": "a@b", "roles": [ROLE_READER]},
+    }))
+    table = AuthTable.from_file(str(p))
+    assert table.resolve("tok1") == ("a@b", [ROLE_READER])
+    assert table.resolve("nope") is None

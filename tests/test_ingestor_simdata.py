@@ -1,0 +1,151 @@
+"""Tests for the metrics ingestor side-car (DataX.Metrics.Ingestor
+analog) and the simulated-data load generator (DataX.SimulatedData
+analog)."""
+
+import json
+import time
+
+from data_accelerator_tpu.core.config import SettingDictionary
+from data_accelerator_tpu.core.schema import Schema
+from data_accelerator_tpu.obs.ingestor import MetricsIngestor, MetricStreamSender
+from data_accelerator_tpu.obs.metrics import MetricLogger
+from data_accelerator_tpu.obs.store import MetricStore
+from data_accelerator_tpu.runtime.sources import SocketSource
+from data_accelerator_tpu.serve.simulateddata import SimulatedDataService
+
+IOT_SCHEMA = json.dumps({
+    "type": "struct",
+    "fields": [
+        {"name": "deviceDetails", "type": {"type": "struct", "fields": [
+            {"name": "deviceId", "type": "long", "nullable": False,
+             "metadata": {"allowedValues": [1, 2, 3]}},
+            {"name": "deviceType", "type": "string", "nullable": False,
+             "metadata": {"allowedValues": ["Heating", "WindSpeed"]}},
+            {"name": "status", "type": "long", "nullable": False,
+             "metadata": {"allowedValues": [1]}},
+        ]}, "nullable": False, "metadata": {}},
+    ],
+})
+
+
+def _wait(cond, timeout_s=5.0):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return cond()
+
+
+# -- ingestor -------------------------------------------------------------
+
+def test_ingest_line_parses_and_stores():
+    store = MetricStore()
+    ing = MetricsIngestor(store=store, port=0)
+    try:
+        ok = ing.ingest_line(json.dumps(
+            {"app": "DATAX-F", "metric": "Input_Events", "uts": 1000, "value": 5}
+        ))
+        assert ok
+        assert store.points("DATAX-F:Input_Events") == [{"uts": 1000, "val": 5}]
+    finally:
+        ing.close()
+
+
+def test_ingest_bad_lines_counted_not_fatal():
+    store = MetricStore()
+    ing = MetricsIngestor(store=store, port=0)
+    try:
+        assert not ing.ingest_line("not json")
+        assert not ing.ingest_line(json.dumps({"app": "a"}))
+        assert ing.parse_errors == 2
+        assert ing.ingest_line(json.dumps(
+            {"app": "a", "metric": "m", "uts": 1, "value": 2}
+        ))
+    finally:
+        ing.close()
+
+
+def test_sender_to_ingestor_over_tcp():
+    store = MetricStore()
+    ing = MetricsIngestor(store=store, port=0)
+    sender = MetricStreamSender("127.0.0.1", ing.port)
+    try:
+        sender("DATAX-F:Latency-Batch", 2000, 12.5)
+        sender("DATAX-F:Latency-Batch", 3000, 13.5)
+        assert _wait(lambda: ing.metrics_sent == 2)
+        pts = store.points("DATAX-F:Latency-Batch")
+        assert [p["val"] for p in pts] == [12.5, 13.5]
+    finally:
+        sender.close()
+        ing.close()
+
+
+def test_metric_logger_eventhub_conf_routes_to_ingestor():
+    store = MetricStore()
+    ing = MetricsIngestor(store=store, port=0)
+    try:
+        d = SettingDictionary({
+            "datax.job.name": "F2",
+            "datax.job.process.metric.eventhub": f"127.0.0.1:{ing.port}",
+        })
+        ml = MetricLogger.from_conf(d)
+        assert ml.eventhub_sender is not None
+        ml.send_metric("Input_Events", 7, 5000)
+        assert _wait(lambda: ing.metrics_sent == 1)
+        assert store.points("DATAX-F2:Input_Events")[0]["val"] == 7
+    finally:
+        ing.close()
+
+
+# -- simulated data -------------------------------------------------------
+
+def test_simdata_batch_rule_overlay_deep_merges():
+    schema = Schema.from_spark_json(IOT_SCHEMA)
+    svc = SimulatedDataService(
+        schema, "127.0.0.1", 9, rule_rows=[
+            {"deviceDetails": {"deviceType": "DoorLock", "status": 0}},
+        ], seed=1,
+    )
+    rows = svc.make_batch(3, 1000, with_rules=True)
+    triggered = [r for r in rows
+                 if r["deviceDetails"]["deviceType"] == "DoorLock"]
+    assert len(triggered) == 1
+    # sibling fields survive the overlay
+    assert triggered[0]["deviceDetails"]["deviceId"] in (1, 2, 3)
+    assert triggered[0]["deviceDetails"]["status"] == 0
+
+
+def test_simdata_dotted_rule_keys():
+    schema = Schema.from_spark_json(IOT_SCHEMA)
+    svc = SimulatedDataService(
+        schema, "127.0.0.1", 9,
+        rule_rows=[{"deviceDetails.status": 0}], seed=1,
+    )
+    rows = svc.make_batch(2, 1000, with_rules=True)
+    assert any(r["deviceDetails"]["status"] == 0 for r in rows)
+
+
+def test_simdata_feeds_socket_source_at_rate():
+    schema = Schema.from_spark_json(IOT_SCHEMA)
+    src = SocketSource(port=0)
+    svc = SimulatedDataService(
+        schema, "127.0.0.1", src.port,
+        events_per_second=2000, rule_period_s=0.0,
+        rule_rows=[{"deviceDetails": {"status": 0}}], seed=2,
+    )
+    try:
+        svc.start()
+        rows = []
+        deadline = time.time() + 5
+        while time.time() < deadline and len(rows) < 200:
+            got, _ = src.poll(1000)
+            rows.extend(got)
+            src.ack()
+            time.sleep(0.02)
+        assert len(rows) >= 200
+        assert svc.rule_events_sent > 0
+        assert any(r["deviceDetails"]["status"] == 0 for r in rows)
+    finally:
+        svc.stop()
+        src.close()

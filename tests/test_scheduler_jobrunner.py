@@ -1,0 +1,113 @@
+"""Tests for the TimedScheduler (DataX.Flow.Scheduler analog) and the
+JobRunner scenario probe (Services/JobRunner analog)."""
+
+from data_accelerator_tpu.obs.metrics import MetricLogger
+from data_accelerator_tpu.obs.store import MetricStore
+from data_accelerator_tpu.serve.jobrunner import JobRunner
+from data_accelerator_tpu.serve.scenario import Scenario
+from data_accelerator_tpu.serve.scheduler import TimedScheduler
+
+
+class FakeFlowOps:
+    """Minimal FlowOperation stand-in for scheduler logic tests."""
+
+    def __init__(self, flows):
+        self.flows = {f["name"]: f for f in flows}
+        self.scheduled = []
+
+    def get_all_flows(self):
+        return list(self.flows.values())
+
+    def get_flow(self, name):
+        return self.flows.get(name)
+
+    def schedule_batch(self, name):
+        self.scheduled.append(name)
+        return [{"name": name}]
+
+
+def _flow(name, mode="batching", batch=None):
+    return {"name": name, "gui": {"input": {"mode": mode}, "batch": batch or []}}
+
+
+def test_streaming_flows_never_scheduled():
+    ops = FakeFlowOps([_flow("s1", mode="streaming")])
+    sched = TimedScheduler(ops, interval_s=60)
+    assert sched.tick() == []
+    assert ops.scheduled == []
+
+
+def test_onetime_runs_exactly_once():
+    ops = FakeFlowOps([
+        _flow("b1", batch=[{"properties": {"type": "oneTime"}}]),
+    ])
+    clock = [1000.0]
+    sched = TimedScheduler(ops, interval_s=60, now_fn=lambda: clock[0])
+    assert sched.tick() == ["b1"]
+    clock[0] += 10000
+    assert sched.tick() == []
+    assert ops.scheduled == ["b1"]
+
+
+def test_recurring_respects_interval():
+    ops = FakeFlowOps([
+        _flow("b2", batch=[{"properties": {"type": "recurring",
+                                           "intervalSeconds": 100}}]),
+    ])
+    clock = [0.0]
+    sched = TimedScheduler(ops, interval_s=60, now_fn=lambda: clock[0])
+    assert sched.tick() == ["b2"]      # first run immediate
+    clock[0] = 50
+    assert sched.tick() == []          # not due yet
+    clock[0] = 120
+    assert sched.tick() == ["b2"]      # due again
+    assert ops.scheduled == ["b2", "b2"]
+
+
+def test_failed_schedule_does_not_mark_ran():
+    ops = FakeFlowOps([
+        _flow("b3", batch=[{"properties": {"type": "oneTime"}}]),
+    ])
+
+    calls = []
+
+    def boom(name):
+        calls.append(name)
+        raise RuntimeError("generation failed")
+
+    ops.schedule_batch = boom
+    sched = TimedScheduler(ops, interval_s=60)
+    assert sched.tick() == []
+    # still due next tick since the round failed
+    assert sched.due_flows() == ["b3"]
+    assert calls == ["b3"]
+
+
+def test_jobrunner_records_history_and_metrics():
+    store = MetricStore()
+    ok = Scenario("deploy")
+    ok.step(lambda ctx: ctx.update(x=1))
+    bad = Scenario("query")
+
+    def failing(ctx):
+        raise AssertionError("kernel down")
+
+    bad.step(failing)
+    runner = JobRunner(
+        [ok, bad], metric_logger=MetricLogger("DATAX-JobRunner", store=store)
+    )
+    results = runner.run_once()
+    assert [r.success for r in results] == [True, False]
+    assert [h["scenario"] for h in runner.history] == ["deploy", "query"]
+    assert runner.history[1]["failedStep"] == "failing"
+    assert store.points("DATAX-JobRunner:deploy")[0]["val"] == 1
+    assert store.points("DATAX-JobRunner:query")[0]["val"] == 0
+
+
+def test_jobrunner_history_bounded():
+    sc = Scenario("s")
+    sc.step(lambda ctx: None)
+    runner = JobRunner([sc], max_history=3)
+    for _ in range(5):
+        runner.run_once()
+    assert len(runner.history) == 3
