@@ -101,6 +101,9 @@ class MetricsIngestor:
         except OSError:
             pass
 
+    # lifecycle alias so service composition can stop() every part
+    stop = close
+
 
 class MetricStreamSender:
     """Producer half: ships metric points over TCP to the ingestor.

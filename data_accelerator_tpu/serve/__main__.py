@@ -1,10 +1,20 @@
-"""Run the control-plane service: ``python -m data_accelerator_tpu.serve``.
+"""Run the control plane (and optionally the full one-box stack):
+``python -m data_accelerator_tpu.serve``.
 
-Args (key=value): port=5000 root=/tmp/dxtpu-serve roles=false
+Args (key=value):
+  port=5000          control-plane REST port
+  root=/tmp/dxtpu-serve   storage root
+  roles=false        require X-DataX-Roles on mutating routes
+  web=0              website port (0 = no website)
+  gateway=0          gateway port (0 = no gateway; website then talks
+                     to the API in-process, the one-box wiring)
+  authfile=          gateway auth table JSON (token -> user/roles)
+  ingest=0           metrics-ingestor TCP port (0 = off)
+  scheduler=0        batch scheduler tick seconds (0 = off)
 
-The one-box analog of the reference's Flow.ManagementService container
-entry (DeploymentLocal/finalrun.sh): all four flow services + gateway
-role gate in one process, local file storage under ``root``.
+The one-box analog of the reference's local container entry
+(DeploymentLocal/finalrun.sh): flow services + gateway + website +
+metrics path in one process, local file storage under ``root``.
 """
 
 import logging
@@ -17,26 +27,98 @@ from .storage import LocalDesignTimeStorage, LocalRuntimeStorage
 
 def main(argv=None):
     logging.basicConfig(level=logging.INFO)
+    log = logging.getLogger(__name__)
     args = dict(
         a.split("=", 1) for a in (argv or sys.argv[1:]) if "=" in a
     )
     root = args.get("root", "/tmp/dxtpu-serve")
     port = int(args.get("port", "5000"))
+    web_port = int(args.get("web", "0") or 0)
+    env_tokens = {}
+    if web_port:
+        # jobs POST metrics to the website in one-box mode
+        # (the localMetricsHttpEndpoint wiring, DeploymentLocal samples)
+        env_tokens["localMetricsHttpEndpoint"] = (
+            f"http://127.0.0.1:{web_port}/metrics/post"
+        )
     flow_ops = FlowOperation(
         LocalDesignTimeStorage(f"{root}/design"),
         LocalRuntimeStorage(f"{root}/runtime"),
+        env_tokens=env_tokens,
     )
     api = DataXApi(
         flow_ops, require_roles=args.get("roles", "false") == "true"
     )
     service = DataXApiService(api, port=port)
-    logging.getLogger(__name__).info(
-        "control plane on :%d (storage %s)", service.port, root
-    )
+    service.start()
+    log.info("control plane on :%d (storage %s)", service.port, root)
+
+    parts = [service]
+    if int(args.get("ingest", "0") or 0):
+        from ..obs.ingestor import MetricsIngestor
+
+        ing = MetricsIngestor(port=int(args["ingest"]))
+        parts.append(ing)
+        log.info("metrics ingestor on :%d", ing.port)
+    gateway = None
+    if int(args.get("gateway", "0") or 0):
+        from .gateway import AuthTable, Gateway
+
+        auth = (
+            AuthTable.from_file(args["authfile"])
+            if args.get("authfile")
+            else AuthTable()
+        )
+        gateway = Gateway(
+            auth,
+            backends={
+                "flow": f"http://127.0.0.1:{service.port}",
+                "interactivequery": f"http://127.0.0.1:{service.port}",
+                "schemainference": f"http://127.0.0.1:{service.port}",
+                "livedata": f"http://127.0.0.1:{service.port}",
+            },
+            port=int(args["gateway"]),
+        )
+        gateway.start()
+        parts.append(gateway)
+        log.info("gateway on :%d", gateway.port)
+    if web_port:
+        from ..web import WebsiteServer
+
+        if gateway is not None:
+            # browser traffic must pass the gateway's role gate
+            web = WebsiteServer(
+                gateway_url=f"http://127.0.0.1:{gateway.port}",
+                gateway_token=args.get("webtoken"),
+                port=web_port,
+            )
+            if not args.get("webtoken"):
+                log.warning("gateway enabled but no webtoken= given; "
+                            "website API calls will be unauthenticated")
+        else:
+            web = WebsiteServer(api=api, port=web_port)
+        web.start()
+        parts.append(web)
+        log.info("website on :%d", web.port)
+    if float(args.get("scheduler", "0") or 0):
+        from .scheduler import TimedScheduler
+
+        sched = TimedScheduler(flow_ops, interval_s=float(args["scheduler"]))
+        sched.start()
+        parts.append(sched)
+        log.info("batch scheduler every %ss", sched.interval_s)
+
     try:
-        service.serve_forever()
+        # the API service already runs on its own thread; park here
+        import threading
+
+        threading.Event().wait()
     except KeyboardInterrupt:
-        service.stop()
+        for p in parts:
+            try:
+                getattr(p, "stop", getattr(p, "close", lambda: None))()
+            except Exception:  # noqa: BLE001 — best-effort shutdown
+                pass
 
 
 if __name__ == "__main__":

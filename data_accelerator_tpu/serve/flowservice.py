@@ -30,11 +30,14 @@ class FlowOperation:
         design_storage: DesignTimeStorage,
         runtime_storage: LocalRuntimeStorage,
         job_client: Optional[TpuJobClient] = None,
+        env_tokens: Optional[dict] = None,
     ):
         self.design = design_storage
         self.runtime = runtime_storage
         self.builder = FlowConfigBuilder()
-        self.generation = RuntimeConfigGeneration(design_storage, runtime_storage)
+        self.generation = RuntimeConfigGeneration(
+            design_storage, runtime_storage, env_tokens=env_tokens
+        )
         self.registry: JobRegistry = self.generation.jobs
         self.jobs = JobOperation(
             self.registry,

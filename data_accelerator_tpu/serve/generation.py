@@ -61,12 +61,17 @@ class RuntimeConfigGeneration:
         design_storage: DesignTimeStorage,
         runtime_storage: LocalRuntimeStorage,
         codegen: Optional[CodegenEngine] = None,
+        env_tokens: Optional[Dict[str, str]] = None,
     ):
         self.design = design_storage
         self.runtime = runtime_storage
         self.codegen = codegen or CodegenEngine()
         self.jobs = JobRegistry(runtime_storage)
         self.rule_gen = RuleDefinitionGenerator()
+        # environment-level token defaults (EngineEnvironment analog,
+        # DataX.Flow.Common/EngineEnvironment.cs:26-237) — e.g. the
+        # one-box website metrics endpoint; flow-level values win
+        self.env_tokens = dict(env_tokens or {})
 
     # -- public entry ----------------------------------------------------
     def generate(self, flow_name: str) -> GenerationResult:
@@ -186,6 +191,10 @@ class RuntimeConfigGeneration:
                 self.runtime.resolve(flow_dir), "processedschema.json"
             ),
         })
+        # environment defaults fill tokens the flow left empty
+        for k, v in self.env_tokens.items():
+            if not tok.get(k):
+                tok.set(k, v)
         ctx["tokens"] = tok
         ctx["flow_dir"] = flow_dir
 

@@ -164,15 +164,24 @@ class KernelService:
             self._kernels[kid] = kernel
         return kid
 
+    def has_sample(self, flow_name: str) -> bool:
+        """True when a persisted sample blob exists for the flow."""
+        return (
+            self.runtime is not None
+            and bool(flow_name)
+            and self.runtime.exists(self._sample_rel(flow_name))
+        )
+
+    @staticmethod
+    def _sample_rel(flow_name: str) -> str:
+        return f"{flow_name}/samples/sample.json"
+
     def _load_sample(self, flow_name: str) -> List[dict]:
-        if self.runtime is None:
-            return []
-        rel = f"{flow_name}/samples/sample.json"
-        if not self.runtime.exists(rel):
+        if not self.has_sample(flow_name):
             return []
         return [
             json.loads(ln)
-            for ln in self.runtime.read_file(rel).splitlines()
+            for ln in self.runtime.read_file(self._sample_rel(flow_name)).splitlines()
             if ln.strip()
         ]
 

@@ -309,12 +309,26 @@ class DataXApi:
                 )
         if not schema_json:
             raise ApiError("inputSchema required (or a saved flow name)")
+        sample_rows = body.get("sampleRows")
+        if sample_rows is None and not self.kernels.has_sample(name):
+            # no persisted sample blob (schema inference never ran):
+            # local/one-box flows sample from the simulated source the
+            # job itself would use, so LiveQuery still has input rows
+            from ..core.schema import Schema
+            from ..utils.datagen import DataGenerator
+
+            try:
+                gen = DataGenerator(Schema.from_spark_json(schema_json))
+                sample_rows = gen.random_rows(50)
+            except (ValueError, KeyError):
+                sample_rows = None
         return {
             "flow_name": name,
             "schema_json": schema_json,
             "normalization": normalization,
-            "sample_rows": body.get("sampleRows"),
+            "sample_rows": sample_rows,
         }
+
 
     def _kernel_create(self, body, query):
         kw = self._kernel_body(body)

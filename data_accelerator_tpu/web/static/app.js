@@ -1,0 +1,515 @@
+/* Data Accelerator TPU — single-page app.
+   reference roles: datax-home (flow list), datax-pipeline (flow
+   designer tabs), datax-query (LiveQuery editor), datax-metrics (live
+   dashboard over the datapoints feed), datax-jobs (job ops). Routing is
+   hash-based; API calls go through the website server's /api bridge. */
+
+"use strict";
+
+const $ = (sel, el) => (el || document).querySelector(sel);
+const h = (tag, attrs, ...kids) => {
+  const el = document.createElement(tag);
+  for (const [k, v] of Object.entries(attrs || {})) {
+    if (k === "onclick" || k.startsWith("on")) el.addEventListener(k.slice(2), v);
+    else if (k === "html") el.innerHTML = v;
+    else el.setAttribute(k, v);
+  }
+  for (const k of kids.flat()) {
+    if (k == null) continue;
+    el.append(k.nodeType ? k : document.createTextNode(k));
+  }
+  return el;
+};
+
+function toast(msg, ok = true) {
+  const t = $("#toast");
+  t.textContent = msg;
+  t.style.borderColor = ok ? "var(--border)" : "var(--serious)";
+  t.hidden = false;
+  clearTimeout(toast._t);
+  toast._t = setTimeout(() => (t.hidden = true), 3500);
+}
+
+async function api(method, path, body) {
+  const resp = await fetch(path, {
+    method,
+    headers: body ? { "Content-Type": "application/json" } : undefined,
+    body: body ? JSON.stringify(body) : undefined,
+  });
+  const payload = await resp.json().catch(() => ({}));
+  if (!resp.ok) {
+    const msg = payload.error && payload.error.message || resp.statusText;
+    throw new Error(msg);
+  }
+  return payload.result !== undefined ? payload.result : payload;
+}
+
+/* ---------------- theme ---------------- */
+const theme = localStorage.getItem("dxtheme");
+if (theme) document.documentElement.dataset.theme = theme;
+$("#themeToggle").onclick = () => {
+  const cur = document.documentElement.dataset.theme === "dark" ? "light" : "dark";
+  document.documentElement.dataset.theme = cur;
+  localStorage.setItem("dxtheme", cur);
+};
+
+/* ---------------- router ---------------- */
+const routes = {};
+function route(prefix, fn) { routes[prefix] = fn; }
+async function render() {
+  const hash = location.hash || "#/flows";
+  const view = $("#view");
+  view.textContent = "";
+  closeLiveFeeds();
+  const key = Object.keys(routes)
+    .sort((a, b) => b.length - a.length)
+    .find((p) => hash.startsWith(p));
+  $("#nav").replaceChildren(
+    ...[["#/flows", "Flows"], ["#/query", "Query"],
+        ["#/metrics", "Metrics"], ["#/jobs", "Jobs"]].map(([href, label]) =>
+      h("a", { href, class: hash.startsWith(href) ? "active" : "" }, label))
+  );
+  try {
+    await routes[key || "#/flows"](view, hash);
+  } catch (e) {
+    view.append(h("div", { class: "card" }, `Error: ${e.message}`));
+  }
+}
+window.addEventListener("hashchange", render);
+
+/* ---------------- flows (datax-home) ---------------- */
+route("#/flows", async (view) => {
+  view.append(h("h1", {}, "Flows"));
+  const flows = await api("GET", "/api/flow/flow/getall/min");
+  const tbl = h("table", { class: "grid" },
+    h("thead", {}, h("tr", {},
+      h("th", {}, "Name"), h("th", {}, "Jobs"), h("th", {}, "Actions"))),
+    h("tbody", {}, flows.map((f) => h("tr", {},
+      h("td", {}, h("a", { href: `#/flow/${f.name}` }, f.displayName || f.name)),
+      h("td", {}, String((f.jobNames || []).length)),
+      h("td", {},
+        h("button", { class: "ghost", onclick: () => location.hash = `#/metrics/${f.name}` }, "metrics"),
+        " ",
+        h("button", {
+          class: "ghost danger", onclick: async () => {
+            if (!confirm(`Delete flow ${f.name}?`)) return;
+            await api("POST", "/api/flow/flow/delete", { flowName: f.name });
+            toast(`deleted ${f.name}`); render();
+          },
+        }, "delete"))))));
+  view.append(tbl);
+  const name = h("input", { placeholder: "new-flow-name" });
+  view.append(h("div", { class: "row" }, name,
+    h("button", {
+      onclick: async () => {
+        if (!name.value) return;
+        await api("POST", "/api/flow/flow/save",
+          { name: name.value, displayName: name.value });
+        location.hash = `#/flow/${name.value}`;
+      },
+    }, "New flow")));
+});
+
+/* ---------------- flow designer (datax-pipeline) ---------------- */
+const TABS = ["info", "input", "query", "rules", "outputs", "scale", "schedule"];
+
+route("#/flow/", async (view, hash) => {
+  const [, , name, tab = "info"] = hash.split("/");
+  const doc = await api("GET", `/api/flow/flow/get?flowName=${encodeURIComponent(name)}`);
+  const gui = doc.gui || {};
+  view.append(h("h1", {}, `Flow: ${gui.displayName || name}`));
+  view.append(h("div", { class: "tabs" }, TABS.map((t) =>
+    h("a", { href: `#/flow/${name}/${t}`, class: t === tab ? "active" : "" }, t))));
+  const pane = h("div", {});
+  view.append(pane);
+
+  const save = async () => {
+    await api("POST", "/api/flow/flow/save", gui);
+    toast("flow saved");
+  };
+  const actions = h("div", { class: "row" },
+    h("button", { onclick: save }, "Save"),
+    h("button", {
+      class: "ghost", onclick: async () => {
+        await save();
+        const r = await api("POST", "/api/flow/flow/generateconfigs", { flowName: name });
+        toast(`generated: ${(r.jobNames || []).join(", ")}`);
+      },
+    }, "Generate configs"),
+    h("button", {
+      class: "ghost", onclick: async () => {
+        const r = await api("POST", "/api/flow/flow/startjobs", { flowName: name });
+        toast(`started ${r.length} job(s)`);
+      },
+    }, "Start"),
+    h("button", {
+      class: "ghost", onclick: async () => {
+        const r = await api("POST", "/api/flow/flow/stopjobs", { flowName: name });
+        toast(`stopped ${r.length} job(s)`);
+      },
+    }, "Stop"));
+  view.append(actions);
+
+  const field = (obj, key, label, opts) => {
+    const input = opts && opts.options
+      ? h("select", {}, opts.options.map((o) =>
+          h("option", { value: o, selected: (obj[key] || "") === o ? "" : null }, o)))
+      : h("input", { value: obj[key] || "", placeholder: (opts && opts.ph) || "" });
+    input.addEventListener("change", () => (obj[key] = input.value));
+    return h("label", { class: "f" }, h("span", {}, label), input);
+  };
+  const area = (obj, key, label) => {
+    const ta = h("textarea", { class: "code" });
+    ta.value = obj[key] || "";
+    ta.addEventListener("change", () => (obj[key] = ta.value));
+    return h("label", { class: "f" }, h("span", {}, label), ta);
+  };
+
+  gui.input = gui.input || {}; gui.input.properties = gui.input.properties || {};
+  gui.process = gui.process || {}; gui.rules = gui.rules || [];
+  gui.outputs = gui.outputs || []; gui.scale = gui.scale || {};
+  gui.batch = gui.batch || [];
+
+  if (tab === "info") {
+    pane.append(field(gui, "displayName", "Display name"));
+    pane.append(field(gui, "databaseName", "Database"));
+    pane.append(h("div", { class: "muted" }, `internal name: ${name}`));
+  } else if (tab === "input") {
+    pane.append(field(gui.input, "mode", "Mode",
+      { options: ["streaming", "batching"] }));
+    pane.append(field(gui.input, "type", "Input type",
+      { options: ["local", "socket", "file", "blobpointer", "events"] }));
+    pane.append(area(gui.input.properties, "inputSchemaFile", "Input schema (JSON)"));
+    pane.append(area(gui.input.properties, "normalizationSnippet", "Normalization"));
+    pane.append(h("button", {
+      class: "ghost", onclick: async () => {
+        const r = await api("POST", "/api/schemainference/inputdata/inferschema",
+          { name, seconds: 10 });
+        gui.input.properties.inputSchemaFile =
+          typeof r.Schema === "string" ? r.Schema : JSON.stringify(r.Schema, null, 1);
+        render(); toast("schema inferred from sample");
+      },
+    }, "Infer schema from sample"));
+  } else if (tab === "query") {
+    pane.append(area(gui, "query", "DataXQuery transform"));
+    pane.append(h("div", { class: "muted" },
+      "--DataXQuery-- blocks; TIMEWINDOW('5 minutes'); OUTPUT t TO sink;"));
+  } else if (tab === "rules") {
+    const list = h("div", {});
+    const renderRules = () => {
+      list.replaceChildren(...gui.rules.map((r, i) => {
+        r.properties = r.properties || {};
+        const p = r.properties;
+        return h("div", { class: "card" },
+          field(p, "ruleDescription", "Description"),
+          field(p, "ruleType", "Type", { options: ["SimpleRule", "AggregateRule"] }),
+          field(p, "conditions", "Condition (SQL expr)",
+            { ph: "deviceType = 'DoorLock' AND status = 0" }),
+          field(p, "alertSinks", "Alert sinks (csv)", { ph: "Metrics" }),
+          field(p, "severity", "Severity", { options: ["Critical", "Medium", "Low"] }),
+          h("button", {
+            class: "ghost danger",
+            onclick: () => { gui.rules.splice(i, 1); renderRules(); },
+          }, "remove rule"));
+      }));
+    };
+    renderRules();
+    pane.append(list, h("button", {
+      class: "ghost",
+      onclick: () => { gui.rules.push({ id: `rule${Date.now()}`, type: "Rule", properties: {} }); renderRules(); },
+    }, "+ add rule"));
+  } else if (tab === "outputs") {
+    const list = h("div", {});
+    const renderOutputs = () => {
+      list.replaceChildren(...gui.outputs.map((o, i) => {
+        o.properties = o.properties || {};
+        return h("div", { class: "card" },
+          field(o, "id", "Output name", { ph: "myOutput" }),
+          field(o, "type", "Sink type",
+            { options: ["blob", "file", "sql", "cosmosdb", "eventhub", "httppost", "metric", "console"] }),
+          field(o.properties, "connectionString", "Connection / folder"),
+          h("button", {
+            class: "ghost danger",
+            onclick: () => { gui.outputs.splice(i, 1); renderOutputs(); },
+          }, "remove output"));
+      }));
+    };
+    renderOutputs();
+    pane.append(list, h("button", {
+      class: "ghost",
+      onclick: () => { gui.outputs.push({ id: "", type: "blob", properties: {} }); renderOutputs(); },
+    }, "+ add output"));
+  } else if (tab === "scale") {
+    pane.append(field(gui.scale, "jobNumChips", "TPU chips", { ph: "1" }));
+    pane.append(field(gui.scale, "jobBatchCapacity", "Batch capacity (rows)", { ph: "65536" }));
+    pane.append(h("div", { class: "muted" },
+      "capacity shards over the chip mesh; collectives ride ICI"));
+  } else if (tab === "schedule") {
+    const list = h("div", {});
+    const renderBatches = () => {
+      list.replaceChildren(...gui.batch.map((b, i) => {
+        b.properties = b.properties || {};
+        return h("div", { class: "card" },
+          field(b.properties, "type", "Type", { options: ["recurring", "oneTime"] }),
+          field(b.properties, "intervalSeconds", "Interval (s)", { ph: "3600" }),
+          field(b.properties, "path", "Input path pattern", { ph: "/data/{yyyy-MM-dd}/*.json" }),
+          field(b.properties, "startTime", "Window start (ISO)"),
+          field(b.properties, "endTime", "Window end (ISO)"),
+          h("button", {
+            class: "ghost danger",
+            onclick: () => { gui.batch.splice(i, 1); renderBatches(); },
+          }, "remove"));
+      }));
+    };
+    renderBatches();
+    pane.append(list, h("button", {
+      class: "ghost",
+      onclick: () => { gui.batch.push({ properties: {} }); renderBatches(); },
+    }, "+ add batch window"));
+  }
+});
+
+/* ---------------- LiveQuery (datax-query) ---------------- */
+route("#/query", async (view) => {
+  view.append(h("h1", {}, "LiveQuery"));
+  const flows = await api("GET", "/api/flow/flow/getall/min");
+  const sel = h("select", {}, flows.map((f) => h("option", { value: f.name }, f.name)));
+  const kernelLabel = h("span", { class: "muted" }, "no kernel");
+  let kernelId = null;
+  const editor = h("textarea", { class: "code", placeholder:
+    "--DataXQuery--\nT = SELECT * FROM DataXProcessedInput WHERE ..." });
+  const out = h("div", {});
+
+  const showTable = (rows, title) => {
+    out.replaceChildren();
+    out.append(h("h2", {}, title));
+    if (!rows || !rows.length) { out.append(h("div", { class: "muted" }, "no rows")); return; }
+    const cols = Object.keys(rows[0]);
+    out.append(h("table", { class: "grid" },
+      h("thead", {}, h("tr", {}, cols.map((c) => h("th", {}, c)))),
+      h("tbody", {}, rows.map((r) => h("tr", {}, cols.map((c) =>
+        h("td", { class: "mono" }, JSON.stringify(r[c]))))))));
+  };
+
+  view.append(h("div", { class: "row" },
+    sel,
+    h("button", {
+      class: "ghost", onclick: async () => {
+        const r = await api("POST", "/api/interactivequery/kernel",
+          { name: sel.value });
+        kernelId = r.kernelId;
+        kernelLabel.textContent = `kernel ${kernelId.slice(0, 8)}…`;
+        toast("kernel ready");
+      },
+    }, "Create kernel"),
+    h("button", {
+      class: "ghost", onclick: async () => {
+        const r = await api("POST", "/api/interactivequery/kernel/refresh",
+          { name: sel.value });
+        kernelId = r.kernelId;
+        kernelLabel.textContent = `kernel ${kernelId.slice(0, 8)}…`;
+        toast("kernel refreshed with fresh sample");
+      },
+    }, "Refresh sample"),
+    kernelLabel));
+  view.append(editor);
+  view.append(h("div", { class: "row" },
+    h("button", {
+      onclick: async () => {
+        if (!kernelId) { toast("create a kernel first", false); return; }
+        const r = await api("POST", "/api/interactivequery/kernel/executequery",
+          { kernelId, query: editor.value, maxRows: 50 });
+        showTable(r.rows || r.result || r, "Result");
+      },
+    }, "Execute"),
+    h("button", {
+      class: "ghost", onclick: async () => {
+        if (!kernelId) { toast("create a kernel first", false); return; }
+        const r = await api("POST", "/api/interactivequery/kernel/executequery",
+          { kernelId, query: "DataXProcessedInput", maxRows: 20 });
+        showTable(r.rows || r.result || r, "Sample input");
+      },
+    }, "Show sample input")));
+  view.append(out);
+});
+
+/* ---------------- metrics dashboard (datax-metrics) ---------------- */
+const liveFeeds = [];
+function closeLiveFeeds() {
+  while (liveFeeds.length) liveFeeds.pop().close();
+}
+
+const SERIES_VARS = ["--series-1", "--series-2", "--series-3"];
+
+function lineChart(container, title) {
+  /* single-metric timechart: 2px line, crosshair+tooltip, recessive
+     grid; series identity from the title (single series, no legend). */
+  const W = 800, H = 180, PL = 54, PB = 18, PT = 8;
+  const card = h("div", { class: "card chart-card" },
+    h("div", { class: "chart-title" }, title));
+  const wrap = h("div", { class: "chart-wrap" });
+  const svg = document.createElementNS("http://www.w3.org/2000/svg", "svg");
+  svg.setAttribute("viewBox", `0 0 ${W} ${H}`);
+  const tip = h("div", { class: "tooltip" });
+  wrap.append(svg, tip);
+  card.append(wrap);
+  container.append(card);
+  const pts = [];  // {t, v}
+  const MAX_POINTS = 600;
+
+  function draw() {
+    svg.replaceChildren();
+    if (pts.length < 2) return;
+    const t0 = pts[0].t, t1 = pts[pts.length - 1].t || t0 + 1;
+    let vmin = Math.min(...pts.map((p) => p.v));
+    let vmax = Math.max(...pts.map((p) => p.v));
+    if (vmin === vmax) { vmin -= 1; vmax += 1; }
+    const x = (t) => PL + (W - PL - 8) * (t - t0) / Math.max(1, t1 - t0);
+    const y = (v) => PT + (H - PT - PB) * (1 - (v - vmin) / (vmax - vmin));
+    const mk = (n, attrs) => {
+      const el = document.createElementNS("http://www.w3.org/2000/svg", n);
+      for (const [k, v] of Object.entries(attrs)) el.setAttribute(k, v);
+      svg.append(el);
+      return el;
+    };
+    for (const frac of [0, 0.5, 1]) {
+      const v = vmin + (vmax - vmin) * frac;
+      mk("line", { x1: PL, x2: W - 8, y1: y(v), y2: y(v), class: "grid-line" });
+      const t = mk("text", { x: PL - 6, y: y(v) + 3, "text-anchor": "end" });
+      t.textContent = fmtVal(v);
+      t.setAttribute("fill", "var(--text-muted)");
+      t.setAttribute("font-size", "10");
+    }
+    const d = pts.map((p, i) => `${i ? "L" : "M"}${x(p.t).toFixed(1)},${y(p.v).toFixed(1)}`).join("");
+    mk("path", { d, class: "series", stroke: `var(${SERIES_VARS[0]})` });
+    const cross = mk("line", { y1: PT, y2: H - PB, stroke: "var(--text-muted)", "stroke-dasharray": "3,3", visibility: "hidden" });
+    const dot = mk("circle", { r: 4, fill: `var(${SERIES_VARS[0]})`, stroke: "var(--surface-2)", "stroke-width": 2, visibility: "hidden" });
+    svg.onmousemove = (ev) => {
+      const rect = svg.getBoundingClientRect();
+      const mx = (ev.clientX - rect.left) * W / rect.width;
+      let best = pts[0], bd = Infinity;
+      for (const p of pts) {
+        const dd = Math.abs(x(p.t) - mx);
+        if (dd < bd) { bd = dd; best = p; }
+      }
+      cross.setAttribute("x1", x(best.t)); cross.setAttribute("x2", x(best.t));
+      cross.setAttribute("visibility", "visible");
+      dot.setAttribute("cx", x(best.t)); dot.setAttribute("cy", y(best.v));
+      dot.setAttribute("visibility", "visible");
+      tip.style.display = "block";
+      tip.style.left = `${(x(best.t) / W) * rect.width + 12}px`;
+      tip.style.top = `${(y(best.v) / H) * rect.height - 10}px`;
+      tip.textContent = `${new Date(best.t).toLocaleTimeString()} — ${fmtVal(best.v)}`;
+    };
+    svg.onmouseleave = () => {
+      cross.setAttribute("visibility", "hidden");
+      dot.setAttribute("visibility", "hidden");
+      tip.style.display = "none";
+    };
+  }
+  return {
+    push(t, v) {
+      pts.push({ t, v });
+      if (pts.length > MAX_POINTS) pts.shift();
+      draw();
+    },
+    seed(points) {
+      pts.splice(0, pts.length, ...points.map((p) => ({ t: p.uts, v: +p.val })));
+      draw();
+    },
+  };
+}
+
+function fmtVal(v) {
+  if (Math.abs(v) >= 1e6) return (v / 1e6).toFixed(1) + "M";
+  if (Math.abs(v) >= 1e3) return (v / 1e3).toFixed(1) + "k";
+  return (+v).toFixed(Math.abs(v) < 10 && v % 1 ? 2 : 0);
+}
+
+route("#/metrics", async (view, hash) => {
+  const flow = hash.split("/")[2] || "";
+  view.append(h("h1", {}, flow ? `Metrics — ${flow}` : "Metrics"));
+  const flows = await api("GET", "/api/flow/flow/getall/min").catch(() => []);
+  const sel = h("select", {},
+    h("option", { value: "" }, "select flow…"),
+    flows.map((f) => h("option", { value: f.name, selected: f.name === flow ? "" : null }, f.name)));
+  sel.addEventListener("change", () => (location.hash = `#/metrics/${sel.value}`));
+  view.append(h("div", { class: "row" }, sel));
+  if (!flow) return;
+
+  const prefix = `DATAX-${flow}:`;
+  const tiles = h("div", { class: "tiles" });
+  const charts = h("div", {});
+  view.append(tiles, charts);
+
+  const tileEls = {};   // metric -> value el
+  const chartEls = {};  // metric -> chart handle
+  const latest = {};
+
+  const ensure = async (metric) => {
+    if (chartEls[metric]) return;
+    const tile = h("div", { class: "tile" },
+      h("div", { class: "k" }, metric),
+      h("div", { class: "v" }, "–"));
+    tiles.append(tile);
+    tileEls[metric] = $(".v", tile);
+    chartEls[metric] = lineChart(charts, metric);
+    const history = await fetch(
+      `/metrics/history?key=${encodeURIComponent(prefix + metric)}`).then((r) => r.json());
+    chartEls[metric].seed(history.slice(-300));
+  };
+
+  const keys = await fetch(`/metrics/keys?prefix=${encodeURIComponent(prefix)}`)
+    .then((r) => r.json());
+  for (const k of keys.sort()) await ensure(k.slice(prefix.length));
+
+  const es = new EventSource(`/metrics/stream?prefix=${encodeURIComponent(prefix)}`);
+  liveFeeds.push(es);
+  es.addEventListener("datapoints", async (ev) => {
+    const { key, member } = JSON.parse(ev.data);
+    const metric = key.slice(prefix.length);
+    let point;
+    try { point = JSON.parse(member); } catch { return; }
+    if (typeof point.val !== "number") return;
+    await ensure(metric);
+    latest[metric] = point.val;
+    tileEls[metric].textContent = fmtVal(point.val);
+    chartEls[metric].push(point.uts, point.val);
+  });
+});
+
+/* ---------------- jobs (datax-jobs) ---------------- */
+route("#/jobs", async (view) => {
+  view.append(h("h1", {}, "Jobs"));
+  const jobs = await api("GET", "/api/flow/job/getall");
+  const body = h("tbody", {}, jobs.map((j) => h("tr", {},
+    h("td", { class: "mono" }, j.name),
+    h("td", {}, h("span", { class: `status ${(j.state || "idle").toLowerCase()}` }, j.state || "idle")),
+    h("td", {}, j.flow || ""),
+    h("td", {},
+      h("button", {
+        class: "ghost", onclick: async () => {
+          await api("POST", "/api/flow/flow/startjobs", { flowName: j.flow });
+          toast("start requested"); render();
+        },
+      }, "start"), " ",
+      h("button", {
+        class: "ghost", onclick: async () => {
+          await api("POST", "/api/flow/flow/stopjobs", { flowName: j.flow });
+          toast("stop requested"); render();
+        },
+      }, "stop")))));
+  view.append(h("table", { class: "grid" },
+    h("thead", {}, h("tr", {},
+      h("th", {}, "Job"), h("th", {}, "State"), h("th", {}, "Flow"), h("th", {}, "Actions"))),
+    body));
+  view.append(h("div", { class: "row" },
+    h("button", {
+      class: "ghost", onclick: async () => {
+        await api("POST", "/api/flow/job/syncall", {});
+        toast("synced"); render();
+      },
+    }, "Sync states")));
+});
+
+render();

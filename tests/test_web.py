@@ -1,0 +1,123 @@
+"""Tests for the website server (Website/ analog): static SPA serving,
+API bridging, metric history/keys, and the SSE datapoints feed."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from data_accelerator_tpu.obs.store import MetricStore
+from data_accelerator_tpu.serve.flowservice import FlowOperation
+from data_accelerator_tpu.serve.restapi import DataXApi
+from data_accelerator_tpu.serve.storage import (
+    LocalDesignTimeStorage,
+    LocalRuntimeStorage,
+)
+from data_accelerator_tpu.web import WebsiteServer
+
+
+@pytest.fixture()
+def web(tmp_path):
+    ops = FlowOperation(
+        LocalDesignTimeStorage(str(tmp_path / "design")),
+        LocalRuntimeStorage(str(tmp_path / "runtime")),
+    )
+    store = MetricStore()
+    srv = WebsiteServer(api=DataXApi(ops), store=store, port=0)
+    srv.start()
+    yield srv, store
+    srv.stop()
+
+
+def _get(srv, path):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}{path}", timeout=10
+        ) as r:
+            return r.status, r.headers.get("Content-Type", ""), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers.get("Content-Type", ""), e.read()
+
+
+def test_serves_spa_shell(web):
+    srv, _ = web
+    status, ctype, body = _get(srv, "/")
+    assert status == 200 and "text/html" in ctype
+    assert b"Data Accelerator" in body
+    status, ctype, _ = _get(srv, "/static/app.js")
+    assert status == 200 and "javascript" in ctype
+    status, ctype, _ = _get(srv, "/static/style.css")
+    assert status == 200 and "css" in ctype
+
+
+def test_spa_fallback_and_traversal_guard(web):
+    srv, _ = web
+    status, ctype, body = _get(srv, "/some/deep/route")
+    assert status == 200 and b"Data Accelerator" in body
+    status, _, _ = _get(srv, "/static/../server.py")
+    assert status in (200, 403)  # normalized back into the shell or refused
+
+
+def test_api_bridge_in_process(web):
+    srv, _ = web
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/api/flow/flow/save",
+        data=json.dumps({"name": "webflow", "displayName": "W"}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 200
+    status, _, body = _get(srv, "/api/flow/flow/getall/min")
+    assert status == 200
+    flows = json.loads(body)["result"]
+    assert flows[0]["name"] == "webflow"
+
+
+def test_metric_history_and_keys(web):
+    srv, store = web
+    store.add_point("DATAX-F:Input", 1000, 5)
+    store.add_point("DATAX-F:Input", 2000, 7)
+    status, _, body = _get(srv, "/metrics/history?key=DATAX-F:Input")
+    assert status == 200
+    assert json.loads(body) == [
+        {"uts": 1000, "val": 5}, {"uts": 2000, "val": 7}
+    ]
+    status, _, body = _get(srv, "/metrics/keys?prefix=DATAX-F")
+    assert json.loads(body) == ["DATAX-F:Input"]
+
+
+def test_composition_page_registry(web):
+    srv, _ = web
+    status, _, body = _get(srv, "/composition")
+    pages = json.loads(body)["pages"]
+    assert {p["name"] for p in pages} >= {"home", "query", "metrics", "jobs"}
+
+
+def test_sse_stream_pushes_datapoints(web):
+    srv, store = web
+    got = []
+
+    def listen():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/metrics/stream?prefix=DATAX-X"
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            for raw in r:
+                line = raw.decode().strip()
+                if line.startswith("data: "):
+                    got.append(json.loads(line[6:]))
+                    return
+
+    t = threading.Thread(target=listen, daemon=True)
+    t.start()
+    time.sleep(0.3)  # let the listener subscribe
+    store.add_point("DATAX-Y:Ignored", 500, 1)   # filtered by prefix
+    store.add_point("DATAX-X:Input", 1000, 42)
+    t.join(timeout=5)
+    assert len(got) == 1
+    assert got[0]["key"] == "DATAX-X:Input"
+    assert json.loads(got[0]["member"]) == {"uts": 1000, "val": 42}
