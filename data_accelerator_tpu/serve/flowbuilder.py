@@ -225,5 +225,9 @@ class RuleDefinitionGenerator:
                 d["$tagname"] = d.pop("$tagName")
             if "$alertSinks" in d and "$alertsinks" not in d:
                 d["$alertsinks"] = d.pop("$alertSinks")
+            # a rule routed to alert sinks is an alert unless said otherwise
+            # (the designer's Alert toggle maps here)
+            if d.get("$alertsinks") and "$isAlert" not in d:
+                d["$isAlert"] = True
             defs.append(d)
         return json.dumps(defs)

@@ -10,6 +10,7 @@ const $ = (sel, el) => (el || document).querySelector(sel);
 const h = (tag, attrs, ...kids) => {
   const el = document.createElement(tag);
   for (const [k, v] of Object.entries(attrs || {})) {
+    if (v == null) continue;
     if (k === "onclick" || k.startsWith("on")) el.addEventListener(k.slice(2), v);
     else if (k === "html") el.innerHTML = v;
     else el.setAttribute(k, v);
@@ -191,7 +192,13 @@ route("#/flow/", async (view, hash) => {
       },
     }, "Infer schema from sample"));
   } else if (tab === "query") {
-    pane.append(area(gui, "query", "DataXQuery transform"));
+    // gui contract: process.queries is a list of script chunks
+    const qobj = { text: (gui.process.queries || []).join("\n") };
+    const ta = area(qobj, "text", "DataXQuery transform");
+    $("textarea", ta).addEventListener("change", (ev) => {
+      gui.process.queries = [ev.target.value];
+    });
+    pane.append(ta);
     pane.append(h("div", { class: "muted" },
       "--DataXQuery-- blocks; TIMEWINDOW('5 minutes'); OUTPUT t TO sink;"));
   } else if (tab === "rules") {
@@ -200,13 +207,19 @@ route("#/flow/", async (view, hash) => {
       list.replaceChildren(...gui.rules.map((r, i) => {
         r.properties = r.properties || {};
         const p = r.properties;
+        if (Array.isArray(p._S_alertSinks)) p._S_alertSinks = p._S_alertSinks.join(",");
+        const sinksField = field(p, "_S_alertSinks", "Alert sinks (csv)", { ph: "Metrics" });
+        $("input", sinksField).addEventListener("change", (ev) => {
+          p._S_alertSinks = ev.target.value.split(",").map((x) => x.trim()).filter(Boolean);
+        });
         return h("div", { class: "card" },
-          field(p, "ruleDescription", "Description"),
-          field(p, "ruleType", "Type", { options: ["SimpleRule", "AggregateRule"] }),
-          field(p, "conditions", "Condition (SQL expr)",
+          field(p, "_S_ruleDescription", "Description"),
+          field(p, "_S_ruleType", "Type", { options: ["SimpleRule", "AggregateRule"] }),
+          field(p, "_S_condition", "Condition (SQL expr)",
             { ph: "deviceType = 'DoorLock' AND status = 0" }),
-          field(p, "alertSinks", "Alert sinks (csv)", { ph: "Metrics" }),
-          field(p, "severity", "Severity", { options: ["Critical", "Medium", "Low"] }),
+          sinksField,
+          field(p, "_S_severity", "Severity", { options: ["Critical", "Medium", "Low"] }),
+          field(p, "_S_isAlert", "Is alert", { options: ["", "true", "false"] }),
           h("button", {
             class: "ghost danger",
             onclick: () => { gui.rules.splice(i, 1); renderRules(); },
@@ -223,11 +236,18 @@ route("#/flow/", async (view, hash) => {
     const renderOutputs = () => {
       list.replaceChildren(...gui.outputs.map((o, i) => {
         o.properties = o.properties || {};
+        const destKey = { blob: "folder", file: "folder", local: "folder",
+                          httppost: "endpoint", eventhub: "connection",
+                          cosmosdb: "connection", sql: "connection" }[o.type];
+        const typeField = field(o, "type", "Sink type",
+          { options: ["blob", "file", "sql", "cosmosdb", "eventhub", "httppost", "metric", "console"] });
+        $("select", typeField).addEventListener("change", () => renderOutputs());
         return h("div", { class: "card" },
           field(o, "id", "Output name", { ph: "myOutput" }),
-          field(o, "type", "Sink type",
-            { options: ["blob", "file", "sql", "cosmosdb", "eventhub", "httppost", "metric", "console"] }),
-          field(o.properties, "connectionString", "Connection / folder"),
+          typeField,
+          destKey ? field(o.properties, destKey,
+            destKey === "folder" ? "Output folder" :
+            destKey === "endpoint" ? "Endpoint URL" : "Connection string") : null,
           h("button", {
             class: "ghost danger",
             onclick: () => { gui.outputs.splice(i, 1); renderOutputs(); },
@@ -240,8 +260,9 @@ route("#/flow/", async (view, hash) => {
       onclick: () => { gui.outputs.push({ id: "", type: "blob", properties: {} }); renderOutputs(); },
     }, "+ add output"));
   } else if (tab === "scale") {
-    pane.append(field(gui.scale, "jobNumChips", "TPU chips", { ph: "1" }));
-    pane.append(field(gui.scale, "jobBatchCapacity", "Batch capacity (rows)", { ph: "65536" }));
+    gui.process.jobconfig = gui.process.jobconfig || {};
+    pane.append(field(gui.process.jobconfig, "jobNumChips", "TPU chips", { ph: "1" }));
+    pane.append(field(gui.process.jobconfig, "jobBatchCapacity", "Batch capacity (rows)", { ph: "65536" }));
     pane.append(h("div", { class: "muted" },
       "capacity shards over the chip mesh; collectives ride ICI"));
   } else if (tab === "schedule") {
@@ -461,7 +482,7 @@ route("#/metrics", async (view, hash) => {
 
   const keys = await fetch(`/metrics/keys?prefix=${encodeURIComponent(prefix)}`)
     .then((r) => r.json());
-  for (const k of keys.sort()) await ensure(k.slice(prefix.length));
+  await Promise.all(keys.sort().map((k) => ensure(k.slice(prefix.length))));
 
   const es = new EventSource(`/metrics/stream?prefix=${encodeURIComponent(prefix)}`);
   liveFeeds.push(es);

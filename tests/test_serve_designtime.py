@@ -230,3 +230,23 @@ class TestLiveQuery:
         kid = svc.create_kernel("SFlow", SCHEMA)
         out = svc.execute(kid, "T = SELECT deviceId FROM DataXProcessedInput")
         assert len(out["result"]) == 4
+
+
+def test_rule_with_alert_sinks_defaults_is_alert():
+    """Designer rules routed to alert sinks expand as alerts without an
+    explicit $isAlert (the Alert-toggle default)."""
+    import json
+
+    from data_accelerator_tpu.serve.flowbuilder import RuleDefinitionGenerator
+
+    out = json.loads(RuleDefinitionGenerator().generate([
+        {"id": "r1", "type": "Rule", "properties": {
+            "_S_ruleType": "SimpleRule",
+            "_S_condition": "status = 0",
+            "_S_alertSinks": ["Metrics"]}},
+        {"id": "r2", "type": "Rule", "properties": {
+            "_S_ruleType": "SimpleRule",
+            "_S_condition": "status = 1"}},
+    ]))
+    assert out[0]["$isAlert"] is True
+    assert "$isAlert" not in out[1]
