@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# One-box entry: full stack in one process tree.
+# reference: DeploymentLocal/finalrun.sh — starts Spark local, the Flow
+# management service, and the website, then tails forever. Here the
+# serve module composes control plane + website + scheduler + metrics
+# ingestor in one process; flow jobs fork off it via the LocalJobClient.
+#
+# Ports: 5000 control-plane REST, 5001 website, 5002 metrics ingestor.
+set -euo pipefail
+
+ROOT="${DATAX_ROOT:-/var/dxtpu}"
+mkdir -p "$ROOT"
+
+exec python -m data_accelerator_tpu.serve \
+  port="${DATAX_API_PORT:-5000}" \
+  web="${DATAX_WEB_PORT:-5001}" \
+  ingest="${DATAX_INGEST_PORT:-5002}" \
+  scheduler="${DATAX_SCHEDULER_INTERVAL:-60}" \
+  roles="${DATAX_REQUIRE_ROLES:-false}" \
+  root="$ROOT"
