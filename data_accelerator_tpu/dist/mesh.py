@@ -71,12 +71,11 @@ def step_shardings(mesh: Mesh):
     ``FlowProcessor``'s step signature:
 
     in:  (raw, ring, state, refdata, base_s, now_rel_ms, slot, delta_ms)
-    out: (datasets, new_ring, new_state, input_count, dataset_counts,
-          dropped_groups)
+    out: (datasets, new_ring, new_state, counts_vec)
     """
     row = row_sharding(mesh)
     ring = ring_sharding(mesh)
     rep = replicated(mesh)
     in_shardings = (row, ring, rep, rep, rep, rep, rep, rep)
-    out_shardings = (rep, ring, rep, rep, rep, rep)
+    out_shardings = (rep, ring, rep, rep)
     return in_shardings, out_shardings

@@ -350,3 +350,42 @@ def test_group_capacity_overflow_metric():
     out = view.fn({"T": t}, jnp.int32(0), jnp.int32(0))
     assert int(out.count()) == 8  # capacity-bounded
     assert int(out.cols["__overflow.groups"][0]) == 32 - 8
+
+
+def test_output_counts_follow_declaration_order(tmp_path):
+    """Packed counts must unpack by packing order, not the sorted dict
+    order jax gives output pytrees (regression: OpenDoors/HeatAvg swap)."""
+    import json as _json
+
+    from data_accelerator_tpu.core.config import SettingDictionary
+    from data_accelerator_tpu.runtime.processor import FlowProcessor
+
+    schema = _json.dumps({"type": "struct", "fields": [
+        {"name": "v", "type": "long", "nullable": False,
+         "metadata": {"allowedValues": [1, 2, 3, 4]}},
+    ]})
+    t = tmp_path / "t.transform"
+    # declaration order Zebra, Apple — sorted order would swap them
+    t.write_text(
+        "--DataXQuery--\n"
+        "Zebra = SELECT v FROM DataXProcessedInput WHERE v > 1\n"
+        "--DataXQuery--\n"
+        "Apple = SELECT v FROM DataXProcessedInput WHERE v = 1\n"
+    )
+    proc = FlowProcessor(
+        SettingDictionary({
+            "datax.job.name": "OrderFlow",
+            "datax.job.input.default.blobschemafile": schema,
+            "datax.job.process.transform": str(t),
+            "datax.job.process.batchcapacity": "8",
+        }),
+        output_datasets=["Zebra", "Apple"],
+    )
+    raw = proc.encode_rows(
+        [{"v": 1}, {"v": 2}, {"v": 3}, {"v": 4}], 0
+    )
+    datasets, metrics = proc.process_batch(raw, batch_time_ms=1000)
+    assert sorted(r["v"] for r in datasets["Zebra"]) == [2, 3, 4]
+    assert [r["v"] for r in datasets["Apple"]] == [1]
+    assert metrics["Output_Zebra_Events_Count"] == 3.0
+    assert metrics["Output_Apple_Events_Count"] == 1.0
