@@ -77,15 +77,14 @@ class StreamingHost:
         self._stop = False
 
     # -- loop -------------------------------------------------------------
-    def run_batch(self) -> Dict[str, float]:
-        """One micro-batch: poll -> encode -> device step -> sinks ->
-        metrics -> checkpoint."""
+    def _poll_and_encode(self):
+        """Poll the source and encode one device batch; returns
+        (raw, consumed offsets, batch_time_ms, t0)."""
         t0 = time.time()
         batch_time_ms = int(t0 * 1000)
         max_events = min(
             self.processor.batch_capacity, int(self.max_rate * self.interval_s)
         )
-
         if isinstance(self.source, LocalSource):
             cols, now_ms, consumed = self.source.poll_columns(
                 max_events, self.processor.dictionary
@@ -101,19 +100,23 @@ class StreamingHost:
         else:
             rows, consumed = self.source.poll(max_events)
             raw = self.processor.encode_rows(rows, (batch_time_ms // 1000) * 1000)
+        return raw, consumed, batch_time_ms, t0
 
-        self.telemetry.batch_begin(batch_time_ms)
+    def _finish(self, handle, consumed, batch_time_ms, t0) -> Dict[str, float]:
+        """Collect a batch and run its tail: sinks -> commit -> ack ->
+        metrics -> checkpoint. Failures requeue un-acked source batches
+        and rethrow so the batch retries, at-least-once
+        (CommonProcessorFactory.scala:382-398)."""
         try:
-            datasets, metrics = self.processor.process_batch(raw, batch_time_ms)
+            datasets, metrics = handle.collect()
             self.dispatcher.dispatch(datasets, batch_time_ms)
             self.processor.commit()
             self.source.ack()
         except Exception as e:
-            # log + rethrow so the batch retries, at-least-once
-            # (CommonProcessorFactory.scala:382-398)
             self.telemetry.track_exception(
                 e, {"event": "error/streaming/process", "batchTime": batch_time_ms}
             )
+            self.source.requeue_unacked()
             logger.exception("batch processing failed; rethrowing for retry")
             raise
 
@@ -125,15 +128,21 @@ class StreamingHost:
             self.batches_processed + 1,
             " ".join(f"{k}={v:.1f}" for k, v in sorted(metrics.items())),
         )
-
         if self.checkpointer and (
             t0 - self._last_checkpoint >= self.checkpoint_interval_s
         ):
             self.checkpointer.checkpoint_batch(consumed)
             self._last_checkpoint = t0
-
         self.batches_processed += 1
         return metrics
+
+    def run_batch(self) -> Dict[str, float]:
+        """One micro-batch: poll -> encode -> device step -> sinks ->
+        metrics -> checkpoint."""
+        raw, consumed, batch_time_ms, t0 = self._poll_and_encode()
+        self.telemetry.batch_begin(batch_time_ms)
+        handle = self.processor.dispatch_batch(raw, batch_time_ms)
+        return self._finish(handle, consumed, batch_time_ms, t0)
 
     def run(self, max_batches: Optional[int] = None) -> None:
         """Paced loop (streaming.intervalInSeconds cadence,
@@ -146,6 +155,34 @@ class StreamingHost:
             sleep = self.interval_s - (time.time() - start)
             if sleep > 0:
                 time.sleep(sleep)
+
+    def run_pipelined(self, max_batches: Optional[int] = None) -> None:
+        """Unpaced loop with one batch in flight: while the device runs
+        batch N, the host encodes and dispatches N+1, then collects N
+        and runs its sinks — throughput mode, where the wall-clock per
+        batch is max(device, host) instead of their sum (the reference's
+        receiver-thread overlap, P6, done on the device stream).
+
+        At-least-once holds across the depth-2 window: each batch joins
+        the source's un-acked FIFO at poll time and is acked (in order)
+        only after its own sinks succeed; a failure requeues every
+        un-acked batch before rethrowing."""
+        pending = None  # (PendingBatch, consumed offsets, batch_time_ms, t0)
+        while not self._stop:
+            inflight = 1 if pending is not None else 0
+            if (
+                max_batches is not None
+                and self.batches_processed + inflight >= max_batches
+            ):
+                break
+            raw, consumed, batch_time_ms, t0 = self._poll_and_encode()
+            self.telemetry.batch_begin(batch_time_ms)
+            handle = self.processor.dispatch_batch(raw, batch_time_ms)
+            if pending is not None:
+                self._finish(*pending)
+            pending = (handle, consumed, batch_time_ms, t0)
+        if pending is not None and not self._stop:
+            self._finish(*pending)
 
     def stop(self) -> None:
         self._stop = True

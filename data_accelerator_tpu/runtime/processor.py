@@ -399,9 +399,11 @@ class FlowProcessor:
             return (datasets, new_ring, new_state, counts_vec)
 
         self._step_fn = step
-        # donate ring + state: the old buffers are dead after the step,
-        # so XLA updates the (large) window ring in place instead of
-        # allocating a copy each batch
+        # donate the ring: the old buffer is dead after the step, so XLA
+        # updates the (large) window ring in place instead of allocating
+        # a copy each batch. State tables are NOT donated — a pipelined
+        # PendingBatch still reads its state for the A/B overwrite after
+        # the next batch has been dispatched.
         if self.mesh is not None:
             from ..dist.mesh import step_shardings
 
@@ -410,10 +412,10 @@ class FlowProcessor:
                 step,
                 in_shardings=in_shardings,
                 out_shardings=out_shardings,
-                donate_argnums=(1, 2),
+                donate_argnums=(1,),
             )
         else:
-            self._step = jax.jit(step, donate_argnums=(1, 2))
+            self._step = jax.jit(step, donate_argnums=(1,))
 
     # -- per-batch host path ----------------------------------------------
     def encode_rows(self, rows: List[dict], base_ms: int) -> TableData:
@@ -500,13 +502,16 @@ class FlowProcessor:
         valid[: min(n, cap)] = True
         return TableData(cols, jnp.asarray(valid))
 
-    def process_batch(
+    def dispatch_batch(
         self, raw: TableData, batch_time_ms: Optional[int] = None
-    ) -> Tuple[Dict[str, List[dict]], Dict[str, float]]:
-        """Run one micro-batch; returns (materialized datasets, metrics).
+    ) -> "PendingBatch":
+        """Queue one micro-batch on the device and return a handle.
 
-        reference: processDataset (CommonProcessorFactory.scala:333-399)
-        incl. the metric names it emits (:344-379).
+        The device runs asynchronously: the caller can encode/dispatch
+        the next batch (or run sinks for the previous one) while this
+        batch computes — the P6 fetch/process overlap, done with the
+        device stream instead of Spark's receiver threads. Collect the
+        results with ``PendingBatch.collect()``.
         """
         t0 = time.time()
         if batch_time_ms is None:
@@ -536,22 +541,68 @@ class FlowProcessor:
             raw, ring, self.state_data, refdata_tables,
             base_s, now_rel_ms, slot, jnp.asarray(delta_ms, jnp.int32),
         )
-        # carry device state forward without materializing
+        # carry device state forward without materializing — the next
+        # dispatch may consume these handles before this batch collects
         if new_ring is not None:
             self.window_buffers["__ring"] = new_ring
         self.state_data = new_state
+        return PendingBatch(
+            self, self.pipeline, out_datasets, new_state, counts_vec,
+            batch_time_ms, new_base_ms, t0,
+        )
 
-        # ONE host sync for every per-batch scalar (layout: input count,
-        # per-output counts, per-output overflow drops), then slice the
-        # device-compacted outputs to their true row counts so only real
-        # rows cross the device->host boundary, fetched in one batched
-        # device_get (transfers overlap)
-        counts = np.asarray(counts_vec)
+    def process_batch(
+        self, raw: TableData, batch_time_ms: Optional[int] = None
+    ) -> Tuple[Dict[str, List[dict]], Dict[str, float]]:
+        """Run one micro-batch; returns (materialized datasets, metrics).
+
+        reference: processDataset (CommonProcessorFactory.scala:333-399)
+        incl. the metric names it emits (:344-379).
+        """
+        return self.dispatch_batch(raw, batch_time_ms).collect()
+
+    def commit(self) -> None:
+        """Commit state-table pointers after sinks succeed."""
+        for st in self.state_tables.values():
+            st.persist()
+
+
+class PendingBatch:
+    """An in-flight micro-batch: device work queued, results not yet
+    fetched. ``collect()`` performs the (single) host sync."""
+
+    def __init__(
+        self, proc: "FlowProcessor", pipeline, out_datasets, state,
+        counts_vec, batch_time_ms: int, base_ms: int, t0: float,
+    ):
+        self.proc = proc
+        # THIS batch's pipeline: a UDF onInterval refresh may rebuild
+        # proc.pipeline before an in-flight batch collects; its outputs
+        # must decode against the schemas of the step that produced them
+        self.pipeline = pipeline
+        self.out_datasets = out_datasets
+        self.state = state  # THIS batch's state, for the A/B overwrite
+        self.counts_vec = counts_vec
+        self.batch_time_ms = batch_time_ms
+        self.base_ms = base_ms
+        self.t0 = t0
+
+    def collect(self) -> Tuple[Dict[str, List[dict]], Dict[str, float]]:
+        """Sync, transfer, materialize; returns (datasets, metrics).
+
+        ONE host sync for every per-batch scalar (layout: input count,
+        per-output counts, per-output overflow slots), then the
+        device-compacted outputs are sliced to their true row counts so
+        only real rows cross the device->host boundary, fetched in one
+        batched device_get (transfers overlap).
+        """
+        proc = self.proc
+        counts = np.asarray(self.counts_vec)
         input_count = int(counts[0])
-        # unpack in PACKING order (self.output_datasets) — jax returns
-        # dict pytrees with sorted keys, so list(out_datasets) may not
-        # match the order the step packed counts in
-        names = list(self.output_datasets)
+        # unpack in PACKING order (proc.output_datasets) — jax returns
+        # dict pytrees with sorted keys, so iterating out_datasets may
+        # not match the order the step packed counts in
+        names = list(proc.output_datasets)
         dataset_counts = {
             n: int(counts[1 + i]) for i, n in enumerate(names)
         }
@@ -567,37 +618,32 @@ class FlowProcessor:
                  for c, v in t.cols.items()},
                 t.valid[: dataset_counts[n]],
             )
-            for n, t in out_datasets.items()
+            for n, t in self.out_datasets.items()
         }
         host_tables = jax.device_get(sliced)
 
-        # materialize outputs
         datasets: Dict[str, List[dict]] = {}
         for name, table in host_tables.items():
             datasets[name] = materialize_rows(
-                table, self.pipeline.schema_of(name), self.dictionary, new_base_ms
+                table, self.pipeline.schema_of(name), proc.dictionary,
+                self.base_ms,
             )
 
         # persist state tables (A/B overwrite; persist() is the caller's
-        # post-sink commit, see StreamingHost)
-        for sname, st in self.state_tables.items():
-            st.overwrite(self.state_data[sname], self.dictionary)
+        # post-sink commit, see StreamingHost) — from THIS batch's state
+        for sname, st in proc.state_tables.items():
+            st.overwrite(self.state[sname], proc.dictionary)
 
-        elapsed_ms = (time.time() - t0) * 1000.0
+        elapsed_ms = (time.time() - self.t0) * 1000.0
         metrics = {
             f"Input_{DatasetName.DataStreamProjection}_Events_Count": float(
-                int(input_count)
+                input_count
             ),
             "Latency-Process": elapsed_ms,
-            "BatchProcessedET": float(batch_time_ms),
+            "BatchProcessedET": float(self.batch_time_ms),
         }
         for n, c in dataset_counts.items():
-            metrics[f"Output_{n}_Events_Count"] = float(int(c))
+            metrics[f"Output_{n}_Events_Count"] = float(c)
         for n, c in dropped_groups.items():
-            metrics[f"Output_{n}_GroupsDropped"] = float(int(c))
+            metrics[f"Output_{n}_GroupsDropped"] = float(c)
         return datasets, metrics
-
-    def commit(self) -> None:
-        """Commit state-table pointers after sinks succeed."""
-        for st in self.state_tables.values():
-            st.persist()

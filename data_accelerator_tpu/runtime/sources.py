@@ -45,8 +45,14 @@ class StreamingSource:
         raise NotImplementedError
 
     def ack(self) -> None:
-        """Batch fully processed + sunk: the source may release any
-        in-flight events retained for retry."""
+        """Oldest un-acked batch fully processed + sunk: the source may
+        release events it retained for retry. Called once per polled
+        batch, in order — a pipelined host may hold several un-acked
+        batches in flight."""
+
+    def requeue_unacked(self) -> None:
+        """A batch failed: put every un-acked batch back so the next
+        polls re-deliver them in order (at-least-once within process)."""
 
     def close(self) -> None:
         pass
@@ -184,8 +190,10 @@ class SocketSource(StreamingSource):
     def __init__(self, host: str = "127.0.0.1", port: int = 0, name: str = "socket"):
         self.name = name
         self._buf: List[bytes] = []
-        self._inflight: List[bytes] = []
-        self._inflight_seq = 0
+        # FIFO of un-acked delivered batches [(from_seq, lines)]; ack()
+        # releases the oldest — a pipelined host holds several in flight
+        self._inflight: List[Tuple[int, List[bytes]]] = []
+        self._redeliver: List[Tuple[int, List[bytes]]] = []
         self._lock = threading.Lock()
         self._seq = 0
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -221,27 +229,31 @@ class SocketSource(StreamingSource):
         """Drain up to max_events raw JSON lines as one newline-joined
         blob for the native decoder — no per-event Python parse.
 
-        Drained lines stay in an in-flight list until ``ack()`` so a
-        failed batch re-delivers them on the retry poll (at-least-once
-        within the process; cross-restart replay needs a replayable
-        upstream like the file/blob source)."""
+        Delivered lines join an in-flight FIFO until their ``ack()``;
+        after ``requeue_unacked()`` (a failed batch) the next polls
+        re-deliver the un-acked batches in order (at-least-once within
+        the process; cross-restart replay needs a replayable upstream
+        like the file/blob source)."""
         with self._lock:
-            if self._inflight:
-                # previous batch not acked: re-deliver it first
-                lines = self._inflight[:max_events]
-                frm = self._inflight_seq
+            if self._redeliver:
+                frm, lines = self._redeliver.pop(0)
             else:
                 lines = self._buf[:max_events]
                 self._buf = self._buf[max_events:]
-                self._inflight = lines
-                self._inflight_seq = self._seq
                 frm = self._seq
                 self._seq += len(lines)
+            self._inflight.append((frm, lines))
         blob = b"\n".join(lines) + (b"\n" if lines else b"")
         return blob, len(lines), {(self.name, 0): (frm, frm + len(lines))}
 
     def ack(self) -> None:
         with self._lock:
+            if self._inflight:
+                self._inflight.pop(0)
+
+    def requeue_unacked(self) -> None:
+        with self._lock:
+            self._redeliver = self._inflight + self._redeliver
             self._inflight = []
 
     def poll(self, max_events: int) -> Tuple[List[dict], Offsets]:
@@ -303,6 +315,9 @@ class BlobPointerSource(StreamingSource):
 
     def ack(self) -> None:
         self.inner.ack()
+
+    def requeue_unacked(self) -> None:
+        self.inner.requeue_unacked()
 
     def close(self) -> None:
         self.inner.close()
