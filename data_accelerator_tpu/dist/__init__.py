@@ -1,3 +1,9 @@
+from .ingest import (
+    HostIngestPlan,
+    assigned_partitions,
+    global_batch_from_local,
+    local_row_range,
+)
 from .mesh import (
     DATA_AXIS,
     make_mesh,
@@ -9,6 +15,10 @@ from .mesh import (
 
 __all__ = [
     "DATA_AXIS",
+    "HostIngestPlan",
+    "assigned_partitions",
+    "global_batch_from_local",
+    "local_row_range",
     "make_mesh",
     "replicated",
     "ring_sharding",

@@ -1,0 +1,121 @@
+"""Multi-host ingest: per-host feeds assembled into one sharded batch.
+
+reference role: EventHub/Kafka partitions are consumed by whichever
+executor holds them and rows live where they land; Spark's shuffle
+repairs placement later (SURVEY §2.3 P1/C2). TPU-native shape: each
+host process ingests its own slice of the stream over DCN (its
+SocketSource port / its Kafka partition set), encodes rows into the
+row-range its local devices own, and the global device array is
+assembled WITHOUT any cross-host data movement —
+``jax.make_array_from_process_local_data`` just stamps the local shards
+as one global array. Cross-chip movement then happens only inside the
+compiled step, over ICI, where XLA schedules it.
+
+Partition assignment mirrors the reference's EventProcessorHost lease
+model (partitions balanced across consumers): partition p belongs to
+host ``p % process_count``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..compile.planner import TableData
+from .mesh import row_sharding
+
+
+def assigned_partitions(
+    n_partitions: int,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> List[int]:
+    """Stream partitions this host consumes (lease-balance analog)."""
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    return [p for p in range(n_partitions) if p % pc == pi]
+
+
+def local_row_range(
+    mesh: Mesh, global_rows: int, process_index: Optional[int] = None
+) -> range:
+    """The [start, stop) row range of a globally row-sharded array that
+    this host's local devices own. Hosts encode ONLY these rows."""
+    sharding = row_sharding(mesh)
+    pi = jax.process_index() if process_index is None else process_index
+    lo, hi = None, None
+    for device, idx in sharding.devices_indices_map((global_rows,)).items():
+        if device.process_index != pi:
+            continue
+        sl = idx[0]
+        start = sl.start or 0
+        stop = sl.stop if sl.stop is not None else global_rows
+        lo = start if lo is None else min(lo, start)
+        hi = stop if hi is None else max(hi, stop)
+    if lo is None:
+        return range(0)
+    return range(lo, hi)
+
+
+def global_batch_from_local(
+    mesh: Mesh,
+    local_cols: Dict[str, np.ndarray],
+    local_valid: np.ndarray,
+    global_rows: int,
+) -> TableData:
+    """Assemble the globally row-sharded device batch from this host's
+    locally-ingested rows (no cross-host transfer: every host calls this
+    with its own shard; jax stitches the metadata)."""
+    sharding = row_sharding(mesh)
+
+    def put(arr: np.ndarray) -> jax.Array:
+        shape = (global_rows,) + arr.shape[1:]
+        return jax.make_array_from_process_local_data(sharding, arr, shape)
+
+    cols = {c: put(v) for c, v in local_cols.items()}
+    return TableData(cols, put(local_valid))
+
+
+class HostIngestPlan:
+    """One host's slice of the ingest work for a flow.
+
+    Carries what the control plane computes per TPU host at job-config
+    time: which stream partitions to consume, how many of the global
+    batch rows to encode, and the per-host rate share of the flow's
+    maxRate (EventHubStreamingFactory.scala:43's rate limiter, split
+    across hosts).
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        global_capacity: int,
+        n_partitions: int,
+        max_rate: float,
+        process_index: Optional[int] = None,
+        process_count: Optional[int] = None,
+    ):
+        self.mesh = mesh
+        self.global_capacity = global_capacity
+        pc = jax.process_count() if process_count is None else process_count
+        self.partitions = assigned_partitions(
+            n_partitions, process_index, process_count
+        )
+        self.rows = local_row_range(mesh, global_capacity, process_index)
+        self.local_capacity = len(self.rows)
+        self.max_rate = max_rate / max(1, pc)
+
+    def make_global(
+        self, local_cols: Dict[str, np.ndarray], local_valid: np.ndarray
+    ) -> TableData:
+        if len(local_valid) != self.local_capacity:
+            raise ValueError(
+                f"host shard must be exactly {self.local_capacity} rows, "
+                f"got {len(local_valid)}"
+            )
+        return global_batch_from_local(
+            self.mesh, local_cols, local_valid, self.global_capacity
+        )

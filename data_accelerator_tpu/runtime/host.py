@@ -136,13 +136,23 @@ class StreamingHost:
         self.batches_processed += 1
         return metrics
 
+    def _start_batch(self):
+        """Poll + encode + dispatch one batch; a failure anywhere here
+        (bad payload, re-trace error) requeues the polled batch so a
+        later batch's ack can't release it unprocessed."""
+        try:
+            raw, consumed, batch_time_ms, t0 = self._poll_and_encode()
+            self.telemetry.batch_begin(batch_time_ms)
+            handle = self.processor.dispatch_batch(raw, batch_time_ms)
+        except Exception:
+            self.source.requeue_unacked()
+            raise
+        return handle, consumed, batch_time_ms, t0
+
     def run_batch(self) -> Dict[str, float]:
         """One micro-batch: poll -> encode -> device step -> sinks ->
         metrics -> checkpoint."""
-        raw, consumed, batch_time_ms, t0 = self._poll_and_encode()
-        self.telemetry.batch_begin(batch_time_ms)
-        handle = self.processor.dispatch_batch(raw, batch_time_ms)
-        return self._finish(handle, consumed, batch_time_ms, t0)
+        return self._finish(*self._start_batch())
 
     def run(self, max_batches: Optional[int] = None) -> None:
         """Paced loop (streaming.intervalInSeconds cadence,
@@ -175,12 +185,10 @@ class StreamingHost:
                 and self.batches_processed + inflight >= max_batches
             ):
                 break
-            raw, consumed, batch_time_ms, t0 = self._poll_and_encode()
-            self.telemetry.batch_begin(batch_time_ms)
-            handle = self.processor.dispatch_batch(raw, batch_time_ms)
+            started = self._start_batch()
             if pending is not None:
                 self._finish(*pending)
-            pending = (handle, consumed, batch_time_ms, t0)
+            pending = started
         if pending is not None and not self._stop:
             self._finish(*pending)
 

@@ -567,6 +567,12 @@ class FlowProcessor:
             st.persist()
 
 
+# batches at or below this capacity fetch counts + whole outputs in one
+# device_get instead of syncing counts first and slicing on device —
+# one host<->device round-trip instead of two (latency mode)
+SMALL_FETCH_ROWS = 16384
+
+
 class PendingBatch:
     """An in-flight micro-batch: device work queued, results not yet
     fetched. ``collect()`` performs the (single) host sync."""
@@ -597,7 +603,16 @@ class PendingBatch:
         batched device_get (transfers overlap).
         """
         proc = self.proc
-        counts = np.asarray(self.counts_vec)
+        if proc.batch_capacity <= SMALL_FETCH_ROWS:
+            # latency mode: batches this small transfer whole-table in
+            # ONE round-trip (counts + outputs together) — the extra
+            # bytes cost less than a second host<->device sync
+            counts, host_full = jax.device_get(
+                (self.counts_vec, self.out_datasets)
+            )
+        else:
+            counts = np.asarray(self.counts_vec)
+            host_full = None
         input_count = int(counts[0])
         # unpack in PACKING order (proc.output_datasets) — jax returns
         # dict pytrees with sorted keys, so iterating out_datasets may
@@ -611,6 +626,9 @@ class PendingBatch:
             for i, n in enumerate(names)
             if int(counts[1 + len(names) + i]) >= 0
         }
+        source_tables = (
+            host_full if host_full is not None else self.out_datasets
+        )
         sliced = {
             n: TableData(
                 {c: v[: dataset_counts[n]]
@@ -618,9 +636,11 @@ class PendingBatch:
                  for c, v in t.cols.items()},
                 t.valid[: dataset_counts[n]],
             )
-            for n, t in self.out_datasets.items()
+            for n, t in source_tables.items()
         }
-        host_tables = jax.device_get(sliced)
+        host_tables = (
+            sliced if host_full is not None else jax.device_get(sliced)
+        )
 
         datasets: Dict[str, List[dict]] = {}
         for name, table in host_tables.items():
