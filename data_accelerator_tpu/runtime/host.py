@@ -48,6 +48,22 @@ class StreamingHost:
         self.source = source or make_source(input_conf, self.processor.input_schema)
         self.interval_s = self.processor.interval_s
         self.max_rate = int(input_conf.get_or_else("eventhub.maxrate", "1000"))
+        # backpressure: when a batch overruns the interval, shrink the
+        # next poll; recover multiplicatively when batches are fast
+        # (the role maxRate plays statically in the reference — here the
+        # effective rate adapts between maxrate/8 and maxrate)
+        self._rate_scale = 1.0
+
+        # profiler hook (SURVEY §5.1: jax profiler traces replace the
+        # reference's AppInsights profiler): conf
+        # process.telemetry.profilerdir=<dir> traces the first
+        # process.telemetry.profilerbatches=<N> batches
+        tele_conf = dict_.get_sub_dictionary("datax.job.process.telemetry.")
+        self._profiler_dir = tele_conf.get("profilerdir")
+        self._profiler_batches = int(
+            tele_conf.get_or_else("profilerbatches", "5")
+        )
+        self._profiling = False
 
         # offset checkpointing (EventhubCheckpointer semantics)
         ckpt_dir = input_conf.get("eventhub.checkpointdir") or input_conf.get(
@@ -83,7 +99,8 @@ class StreamingHost:
         t0 = time.time()
         batch_time_ms = int(t0 * 1000)
         max_events = min(
-            self.processor.batch_capacity, int(self.max_rate * self.interval_s)
+            self.processor.batch_capacity,
+            max(1, int(self.max_rate * self.interval_s * self._rate_scale)),
         )
         if isinstance(self.source, LocalSource):
             cols, now_ms, consumed = self.source.poll_columns(
@@ -121,6 +138,7 @@ class StreamingHost:
             raise
 
         metrics["Latency-Batch"] = (time.time() - t0) * 1000.0
+        metrics["IngestRateScale"] = self._rate_scale
         self.telemetry.batch_end(batch_time_ms, {"latencyMs": metrics["Latency-Batch"]})
         self.metric_logger.send_batch_metrics(metrics, batch_time_ms)
         logger.info(
@@ -136,10 +154,27 @@ class StreamingHost:
         self.batches_processed += 1
         return metrics
 
+    def _profiler_tick(self) -> None:
+        """Trace the first N batches into profilerdir (jax profiler —
+        view with tensorboard/xprof; replaces AppInsights' profiler)."""
+        if not self._profiler_dir:
+            return
+        import jax
+
+        if not self._profiling and self.batches_processed == 0:
+            jax.profiler.start_trace(self._profiler_dir)
+            self._profiling = True
+            logger.info("jax profiler tracing -> %s", self._profiler_dir)
+        elif self._profiling and self.batches_processed >= self._profiler_batches:
+            jax.profiler.stop_trace()
+            self._profiling = False
+            logger.info("jax profiler trace written to %s", self._profiler_dir)
+
     def _start_batch(self):
         """Poll + encode + dispatch one batch; a failure anywhere here
         (bad payload, re-trace error) requeues the polled batch so a
         later batch's ack can't release it unprocessed."""
+        self._profiler_tick()
         try:
             raw, consumed, batch_time_ms, t0 = self._poll_and_encode()
             self.telemetry.batch_begin(batch_time_ms)
@@ -149,22 +184,39 @@ class StreamingHost:
             raise
         return handle, consumed, batch_time_ms, t0
 
+    def _update_backpressure(self, busy_ms: float) -> None:
+        """Adaptive backpressure on the loop's *busy* time (work per
+        batch, pacing sleep excluded): overrunning the interval halves
+        the next poll (down to 1/8 rate); fast batches recover gently.
+        The static maxRate limiter stays the ceiling
+        (EventHubStreamingFactory.scala:43)."""
+        if busy_ms > self.interval_s * 1000.0:
+            self._rate_scale = max(0.125, self._rate_scale * 0.5)
+        elif busy_ms < self.interval_s * 500.0:
+            self._rate_scale = min(1.0, self._rate_scale * 1.25)
+
     def run_batch(self) -> Dict[str, float]:
         """One micro-batch: poll -> encode -> device step -> sinks ->
         metrics -> checkpoint."""
-        return self._finish(*self._start_batch())
+        metrics = self._finish(*self._start_batch())
+        # synchronous loop: the batch's own latency is the busy time
+        self._update_backpressure(metrics["Latency-Batch"])
+        return metrics
 
     def run(self, max_batches: Optional[int] = None) -> None:
         """Paced loop (streaming.intervalInSeconds cadence,
         StreamingHost.scala:66-67)."""
-        while not self._stop:
-            start = time.time()
-            self.run_batch()
-            if max_batches is not None and self.batches_processed >= max_batches:
-                break
-            sleep = self.interval_s - (time.time() - start)
-            if sleep > 0:
-                time.sleep(sleep)
+        try:
+            while not self._stop:
+                start = time.time()
+                self.run_batch()
+                if max_batches is not None and self.batches_processed >= max_batches:
+                    break
+                sleep = self.interval_s - (time.time() - start)
+                if sleep > 0:
+                    time.sleep(sleep)
+        finally:
+            self._stop_profiler()
 
     def run_pipelined(self, max_batches: Optional[int] = None) -> None:
         """Unpaced loop with one batch in flight: while the device runs
@@ -178,22 +230,39 @@ class StreamingHost:
         only after its own sinks succeed; a failure requeues every
         un-acked batch before rethrowing."""
         pending = None  # (PendingBatch, consumed offsets, batch_time_ms, t0)
-        while not self._stop:
-            inflight = 1 if pending is not None else 0
-            if (
-                max_batches is not None
-                and self.batches_processed + inflight >= max_batches
-            ):
-                break
-            started = self._start_batch()
-            if pending is not None:
+        try:
+            while not self._stop:
+                inflight = 1 if pending is not None else 0
+                if (
+                    max_batches is not None
+                    and self.batches_processed + inflight >= max_batches
+                ):
+                    break
+                iter_t0 = time.time()
+                started = self._start_batch()
+                if pending is not None:
+                    self._finish(*pending)
+                # backpressure on iteration time, not Latency-Batch: a
+                # pipelined batch's latency spans ~2 iterations by design
+                self._update_backpressure((time.time() - iter_t0) * 1000.0)
+                pending = started
+            if pending is not None and not self._stop:
                 self._finish(*pending)
-            pending = started
-        if pending is not None and not self._stop:
-            self._finish(*pending)
+        finally:
+            self._stop_profiler()
+
+    def _stop_profiler(self) -> None:
+        """Flush the jax trace if still recording (loop ended early)."""
+        if self._profiling:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._profiling = False
+            logger.info("jax profiler trace written to %s", self._profiler_dir)
 
     def stop(self) -> None:
         self._stop = True
+        self._stop_profiler()
         self.source.close()
 
 

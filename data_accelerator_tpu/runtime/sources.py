@@ -33,6 +33,39 @@ from ..utils.datagen import DataGenerator
 Offsets = Dict[Tuple[str, int], Tuple[int, int]]
 
 
+class UnackedFifo:
+    """The at-least-once delivery ledger shared by buffering sources:
+    every delivered batch is held until its in-order ``ack``; a failure
+    puts all un-acked batches back for re-delivery. Thread-safe — the
+    pipelined host acks from the same thread it polls, but socket
+    readers touch adjacent state under the same discipline."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight: List = []
+        self._redeliver: List = []
+
+    def next_redelivery(self):
+        """The oldest requeued batch, or None (caller then polls fresh
+        data; either way the result must be ``deliver``-ed)."""
+        with self._lock:
+            return self._redeliver.pop(0) if self._redeliver else None
+
+    def deliver(self, item) -> None:
+        with self._lock:
+            self._inflight.append(item)
+
+    def ack_oldest(self):
+        """Release and return the oldest in-flight batch (None if empty)."""
+        with self._lock:
+            return self._inflight.pop(0) if self._inflight else None
+
+    def requeue_all(self) -> None:
+        with self._lock:
+            self._redeliver = self._inflight + self._redeliver
+            self._inflight = []
+
+
 class StreamingSource:
     """Interface: poll() returns (rows, consumed offsets)."""
 
@@ -190,10 +223,9 @@ class SocketSource(StreamingSource):
     def __init__(self, host: str = "127.0.0.1", port: int = 0, name: str = "socket"):
         self.name = name
         self._buf: List[bytes] = []
-        # FIFO of un-acked delivered batches [(from_seq, lines)]; ack()
-        # releases the oldest — a pipelined host holds several in flight
-        self._inflight: List[Tuple[int, List[bytes]]] = []
-        self._redeliver: List[Tuple[int, List[bytes]]] = []
+        # un-acked delivered batches (from_seq, lines); ack() releases
+        # the oldest — a pipelined host holds several in flight
+        self._fifo = UnackedFifo()
         self._lock = threading.Lock()
         self._seq = 0
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -234,27 +266,24 @@ class SocketSource(StreamingSource):
         re-deliver the un-acked batches in order (at-least-once within
         the process; cross-restart replay needs a replayable upstream
         like the file/blob source)."""
-        with self._lock:
-            if self._redeliver:
-                frm, lines = self._redeliver.pop(0)
-            else:
+        requeued = self._fifo.next_redelivery()
+        if requeued is not None:
+            frm, lines = requeued
+        else:
+            with self._lock:
                 lines = self._buf[:max_events]
                 self._buf = self._buf[max_events:]
                 frm = self._seq
                 self._seq += len(lines)
-            self._inflight.append((frm, lines))
+        self._fifo.deliver((frm, lines))
         blob = b"\n".join(lines) + (b"\n" if lines else b"")
         return blob, len(lines), {(self.name, 0): (frm, frm + len(lines))}
 
     def ack(self) -> None:
-        with self._lock:
-            if self._inflight:
-                self._inflight.pop(0)
+        self._fifo.ack_oldest()
 
     def requeue_unacked(self) -> None:
-        with self._lock:
-            self._redeliver = self._inflight + self._redeliver
-            self._inflight = []
+        self._fifo.requeue_all()
 
     def poll(self, max_events: int) -> Tuple[List[dict], Offsets]:
         blob, n, offsets = self.poll_raw(max_events)
@@ -402,10 +431,11 @@ class KafkaSource(StreamingSource):
     ):
         self.name = name
         self.topics = topics
-        # un-acked FIFO of delivered batches [(rows, offsets)] — the
-        # pipelined host may hold several in flight (see SocketSource)
-        self._inflight: List[Tuple[List[dict], Offsets]] = []
-        self._redeliver: List[Tuple[List[dict], Offsets]] = []
+        # un-acked delivered batches (rows, offsets) — the pipelined
+        # host may hold several in flight (same ledger as SocketSource)
+        self._fifo = UnackedFifo()
+        # checkpointed positions to seek once partitions are assigned
+        self._pending_seek: Dict[Tuple[str, int], int] = {}
         if consumer is not None:
             self._consumer = consumer  # injected for tests
         else:
@@ -439,15 +469,34 @@ class KafkaSource(StreamingSource):
         self._flavor = "injected"
 
     def start(self, positions: Dict[Tuple[str, int], int]) -> None:
-        """Seek to checkpointed offsets (the reference left Kafka offset
-        checkpointing as a TODO, KafkaStreamingFactory.scala:51; here
-        positions from the OffsetCheckpointer override the group's
-        committed position)."""
-        for (topic, partition), seq in positions.items():
+        """Record checkpointed offsets to seek (the reference left Kafka
+        offset checkpointing as a TODO, KafkaStreamingFactory.scala:51;
+        here OffsetCheckpointer positions override the group's committed
+        position). Seeking is deferred until the broker assigns
+        partitions — seek-before-assignment errors on both client
+        libraries — and applied at the top of each consume pass."""
+        self._pending_seek.update(positions)
+        self._apply_pending_seeks()
+
+    def _apply_pending_seeks(self) -> None:
+        if not self._pending_seek:
+            return
+        seek = getattr(self._consumer, "seek", None)
+        if seek is None:
+            return
+        assignment = getattr(self._consumer, "assignment", None)
+        assigned = None
+        if assignment is not None:
             try:
-                seek = getattr(self._consumer, "seek", None)
-                if seek is None:
-                    continue
+                assigned = {
+                    (tp.topic, tp.partition) for tp in (assignment() or [])
+                }
+            except Exception:  # noqa: BLE001 — treat as not-yet-assigned
+                assigned = set()
+        for (topic, partition), seq in list(self._pending_seek.items()):
+            if assigned is not None and (topic, partition) not in assigned:
+                continue  # not assigned to this consumer (yet)
+            try:
                 if self._flavor == "kafka-python":
                     from kafka import TopicPartition  # type: ignore
 
@@ -458,13 +507,15 @@ class KafkaSource(StreamingSource):
                     seek(TopicPartition(topic, partition, seq))
                 else:
                     seek(topic, partition, seq)
-            except Exception as e:  # noqa: BLE001 — best-effort resume
+                del self._pending_seek[(topic, partition)]
+            except Exception as e:  # noqa: BLE001 — retried next pass
                 logger.warning(
-                    "kafka seek %s/%s -> %s failed: %s",
+                    "kafka seek %s/%s -> %s failed (will retry): %s",
                     topic, partition, seq, e,
                 )
 
     def _consume(self, max_events: int) -> Tuple[List[dict], Offsets]:
+        self._apply_pending_seeks()
         rows: List[dict] = []
         offsets: Offsets = {}
         if self._flavor == "kafka-python":
@@ -487,7 +538,10 @@ class KafkaSource(StreamingSource):
             if msg is None:
                 break
             if msg.error():
-                continue
+                # surface broker-side errors and end the pass instead of
+                # spinning on instantly-returned error events
+                logger.warning("kafka message error: %s", msg.error())
+                break
             rows.append(json.loads(msg.value()))
             key = (msg.topic(), msg.partition())
             frm = offsets.get(key, (msg.offset(), msg.offset()))[0]
@@ -499,22 +553,21 @@ class KafkaSource(StreamingSource):
         SocketSource): ack() releases + commits oldest-first, and
         requeue_unacked() re-delivers after a failed batch — the
         broker's committed position only ever advances past sunk data."""
-        if self._redeliver:
-            rows, offsets = self._redeliver.pop(0)
+        requeued = self._fifo.next_redelivery()
+        if requeued is not None:
+            rows, offsets = requeued
         else:
             rows, offsets = self._consume(max_events)
-        self._inflight.append((rows, offsets))
+        self._fifo.deliver((rows, offsets))
         return rows, offsets
 
     def ack(self) -> None:
-        if not self._inflight:
-            return
-        _rows, offsets = self._inflight.pop(0)
-        self._commit(offsets)
+        released = self._fifo.ack_oldest()
+        if released is not None:
+            self._commit(released[1])
 
     def requeue_unacked(self) -> None:
-        self._redeliver = self._inflight + self._redeliver
-        self._inflight = []
+        self._fifo.requeue_all()
 
     def _commit(self, offsets: Offsets) -> None:
         """Commit exactly this batch's end offsets (not the consumer's

@@ -1,0 +1,115 @@
+"""Restart/recovery e2e: offset checkpoint resume, A/B state reload,
+backpressure, and the profiler hook (SURVEY §5.3/§5.4 hardening)."""
+
+import json
+import os
+
+import numpy as np
+
+from data_accelerator_tpu.core.config import SettingDictionary
+from data_accelerator_tpu.runtime.host import StreamingHost
+from data_accelerator_tpu.runtime.sources import FileSource
+
+SCHEMA = json.dumps({"type": "struct", "fields": [
+    {"name": "k", "type": "long", "nullable": False, "metadata": {}},
+    {"name": "v", "type": "double", "nullable": False, "metadata": {}},
+]})
+
+
+def _write_events(path, rows):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def _conf(tmp_path, extra=None):
+    t = tmp_path / "t.transform"
+    if not t.exists():
+        t.write_text(
+            "--DataXQuery--\n"
+            "merged = SELECT k, v FROM DataXProcessedInput "
+            "UNION ALL SELECT k, v FROM seen\n"
+            "--DataXQuery--\n"
+            "seen = SELECT k, MAX(v) AS v FROM merged GROUP BY k\n"
+            "--DataXQuery--\n"
+            "Out = SELECT k, v FROM DataXProcessedInput\n"
+        )
+    d = {
+        "datax.job.name": "RecFlow",
+        "datax.job.input.default.inputtype": "file",
+        "datax.job.input.default.blobpathregex": str(tmp_path / "in" / "*.json"),
+        "datax.job.input.default.blobschemafile": SCHEMA,
+        "datax.job.input.default.eventhub.maxrate": "100",
+        "datax.job.input.default.eventhub.checkpointdir": str(tmp_path / "ckpt"),
+        "datax.job.input.default.eventhub.checkpointinterval": "0 second",
+        "datax.job.input.default.streaming.intervalinseconds": "1",
+        "datax.job.process.transform": str(t),
+        "datax.job.process.batchcapacity": "16",
+        "datax.job.process.statetable.seen.schema": "k long, v double",
+        "datax.job.process.statetable.seen.location": str(tmp_path / "state"),
+        "datax.job.output.Out.console.maxrows": "0",
+    }
+    d.update(extra or {})
+    return SettingDictionary(d)
+
+
+def _state_map(host):
+    loaded = host.processor.state_tables["seen"].load(host.processor.dictionary)
+    return {
+        int(k): float(v) for k, v, ok in zip(
+            np.asarray(loaded.cols["k"]),
+            np.asarray(loaded.cols["v"]),
+            np.asarray(loaded.valid),
+        ) if ok
+    }
+
+
+def test_restart_resumes_offsets_and_state(tmp_path):
+    """Kill the host after batch 1, start a fresh one: the file source
+    resumes past consumed files (offsets.txt) and the A/B state table
+    reloads the accumulated rows."""
+    _write_events(str(tmp_path / "in" / "a.json"),
+                  [{"k": 1, "v": 5.0}, {"k": 2, "v": 7.0}])
+    host1 = StreamingHost(_conf(tmp_path))
+    host1.run_batch()
+    host1.stop()
+    assert os.path.exists(tmp_path / "ckpt" / "offsets.txt")
+    assert _state_map(host1) == {1: 5.0, 2: 7.0}
+
+    # second file arrives; a NEW host process takes over
+    _write_events(str(tmp_path / "in" / "b.json"), [{"k": 1, "v": 9.0}])
+    host2 = StreamingHost(_conf(tmp_path))
+    m = host2.run_batch()
+    host2.stop()
+    # only the new file's rows were ingested (a.json not replayed)
+    assert m["Input_DataXProcessedInput_Events_Count"] == 1.0
+    # state reloaded + accumulated across the restart
+    assert _state_map(host2) == {1: 9.0, 2: 7.0}
+
+
+def test_backpressure_halves_rate_on_overrun(tmp_path, monkeypatch):
+    _write_events(str(tmp_path / "in" / "a.json"), [{"k": 1, "v": 1.0}])
+    host = StreamingHost(_conf(tmp_path, {
+        "datax.job.input.default.streaming.intervalinseconds": "0.001",
+    }))
+    host.run_batch()  # any real batch overruns a 1 ms interval
+    assert host._rate_scale == 0.5
+    host.stop()
+
+
+def test_profiler_hook_writes_trace(tmp_path):
+    prof_dir = tmp_path / "prof"
+    _write_events(str(tmp_path / "in" / "a.json"),
+                  [{"k": 1, "v": 1.0}, {"k": 2, "v": 2.0}])
+    host = StreamingHost(_conf(tmp_path, {
+        "datax.job.process.telemetry.profilerdir": str(prof_dir),
+        "datax.job.process.telemetry.profilerbatches": "1",
+    }))
+    host.run_batch()
+    host.run_batch()  # second batch crosses the stop threshold
+    host.stop()
+    traces = []
+    for root, _d, files in os.walk(prof_dir):
+        traces += [f for f in files if "trace" in f or f.endswith(".pb")]
+    assert traces, f"no profiler trace written under {prof_dir}"
