@@ -119,7 +119,20 @@ def batch_from_rows(
             if col.ctype == ColType.STRING:
                 arr[i] = dictionary.encode(str(v))
             elif col.ctype == ColType.TIMESTAMP:
-                arr[i] = np.int32(int(v) - base_ms)
+                if isinstance(v, str):
+                    # string timestamps parse at the encode boundary —
+                    # the role of the reference's stringToTimestamp
+                    # built-in UDF (BuiltInFunctionsHandler); device
+                    # columns never hold raw date strings
+                    v = parse_timestamp_ms(v)
+                    if v is None:
+                        continue
+                # relative ms saturate at the int32 range: a sample/replay
+                # row weeks away from the batch base clamps (~±24 days)
+                # instead of overflowing
+                arr[i] = np.int32(
+                    max(-2**31, min(2**31 - 1, int(v) - base_ms))
+                )
             elif col.ctype == ColType.BOOLEAN:
                 arr[i] = bool(v)
             elif col.ctype == ColType.LONG:
@@ -181,10 +194,34 @@ def _dig(obj: dict, dotted: str):
     return cur
 
 
+def parse_timestamp_ms(text: str) -> Optional[int]:
+    """Parse a timestamp string to epoch ms (stringToTimestamp role).
+
+    Accepts ISO-8601 (T or space separator, optional fraction/Z) and
+    bare epoch seconds/millis digits; returns None on garbage."""
+    from datetime import datetime, timezone
+
+    s = text.strip()
+    if not s:
+        return None
+    if s.replace(".", "", 1).isdigit():
+        num = float(s)
+        return int(num if num > 1e12 else num * 1000.0)
+    try:
+        t = datetime.fromisoformat(s.replace("Z", "+00:00").replace(" ", "T"))
+    except ValueError:
+        return None
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=timezone.utc)
+    return int(t.timestamp() * 1000)
+
+
 def _first_timestamp(row: dict, schema: Schema) -> Optional[int]:
     for col in schema.columns:
         if col.ctype == ColType.TIMESTAMP:
             v = _dig(row, col.name)
+            if isinstance(v, str):
+                v = parse_timestamp_ms(v)  # unparseable -> fall through
             if v is not None:
                 return int(v)
     return None

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import logging
 import sys
+import threading
 import time
 from typing import Dict, Optional
 
@@ -64,6 +65,8 @@ class StreamingHost:
             tele_conf.get_or_else("profilerbatches", "5")
         )
         self._profiling = False
+        # stop() may run on another thread than the loop's finally
+        self._profiler_lock = threading.Lock()
 
         # offset checkpointing (EventhubCheckpointer semantics)
         ckpt_dir = input_conf.get("eventhub.checkpointdir") or input_conf.get(
@@ -161,14 +164,20 @@ class StreamingHost:
             return
         import jax
 
-        if not self._profiling and self.batches_processed == 0:
-            jax.profiler.start_trace(self._profiler_dir)
-            self._profiling = True
-            logger.info("jax profiler tracing -> %s", self._profiler_dir)
-        elif self._profiling and self.batches_processed >= self._profiler_batches:
-            jax.profiler.stop_trace()
-            self._profiling = False
-            logger.info("jax profiler trace written to %s", self._profiler_dir)
+        with self._profiler_lock:
+            if not self._profiling and self.batches_processed == 0:
+                jax.profiler.start_trace(self._profiler_dir)
+                self._profiling = True
+                logger.info("jax profiler tracing -> %s", self._profiler_dir)
+            elif (
+                self._profiling
+                and self.batches_processed >= self._profiler_batches
+            ):
+                jax.profiler.stop_trace()
+                self._profiling = False
+                logger.info(
+                    "jax profiler trace written to %s", self._profiler_dir
+                )
 
     def _start_batch(self):
         """Poll + encode + dispatch one batch; a failure anywhere here
@@ -253,12 +262,15 @@ class StreamingHost:
 
     def _stop_profiler(self) -> None:
         """Flush the jax trace if still recording (loop ended early)."""
-        if self._profiling:
-            import jax
+        with self._profiler_lock:
+            if self._profiling:
+                import jax
 
-            jax.profiler.stop_trace()
-            self._profiling = False
-            logger.info("jax profiler trace written to %s", self._profiler_dir)
+                jax.profiler.stop_trace()
+                self._profiling = False
+                logger.info(
+                    "jax profiler trace written to %s", self._profiler_dir
+                )
 
     def stop(self) -> None:
         self._stop = True

@@ -476,7 +476,37 @@ class KafkaSource(StreamingSource):
         partitions — seek-before-assignment errors on both client
         libraries — and applied at the top of each consume pass."""
         self._pending_seek.update(positions)
+        if self._pending_seek:
+            self._force_assignment()
         self._apply_pending_seeks()
+
+    def _force_assignment(self) -> None:
+        """Trigger the group rebalance BEFORE the first data batch so
+        checkpoint seeks take effect from batch 1 (assignment happens
+        lazily inside poll on both client libraries). confluent: swap in
+        an on_assign callback that applies the checkpointed offsets at
+        assignment time; kafka-python: a zero-timeout poll assigns (any
+        records it returns are before the seek and re-read after it —
+        duplicates only, at-least-once)."""
+        try:
+            if self._flavor == "confluent":
+                from confluent_kafka import TopicPartition  # type: ignore
+
+                def on_assign(consumer, partitions):
+                    for tp in partitions:
+                        seq = self._pending_seek.pop(
+                            (tp.topic, tp.partition), None
+                        )
+                        if seq is not None:
+                            tp.offset = seq
+                    consumer.assign(partitions)
+
+                self._consumer.subscribe(self.topics, on_assign=on_assign)
+                self._consumer.poll(0)
+            elif self._flavor == "kafka-python":
+                self._consumer.poll(timeout_ms=0, max_records=1)
+        except Exception as e:  # noqa: BLE001 — seeks retry per pass
+            logger.warning("kafka assignment warm-up failed: %s", e)
 
     def _apply_pending_seeks(self) -> None:
         if not self._pending_seek:

@@ -77,6 +77,29 @@ class Kernel:
         the runtime ring buffers)."""
         return _WINDOWED_TABLE_RE.sub(DatasetName.DataStreamProjection, query)
 
+    def _sample_base_ms(self) -> int:
+        """The sample's own epoch-ms origin: the max value of the
+        schema's TIMESTAMP columns across sampled rows — string and
+        nested timestamps included (falls back to now for
+        timestamp-less samples)."""
+        from ..core.batch import _dig, parse_timestamp_ms
+        from ..core.schema import ColType, Schema
+
+        try:
+            schema = Schema.from_spark_json(self.schema_json)
+        except (ValueError, KeyError):
+            return int(time.time() * 1000)
+        ts_cols = [c.name for c in schema.columns if c.ctype == ColType.TIMESTAMP]
+        best = 0
+        for r in self.sample_rows:
+            for cname in ts_cols:
+                v = _dig(r, cname)
+                if isinstance(v, str):
+                    v = parse_timestamp_ms(v)
+                if isinstance(v, (int, float)) and v > 0:
+                    best = max(best, int(v))
+        return best or int(time.time() * 1000)
+
     def execute(self, query: str, max_rows: int = DEFAULT_MAX_ROWS) -> dict:
         """Compile + run the query against the sampled batch; returns
         {"headers": [...], "result": [rows]} like the reference's
@@ -108,7 +131,11 @@ class Kernel:
                 )
                 self._processors[text] = proc
 
-        base_ms = int(time.time() * 1000)
+        # anchor the batch at the SAMPLE's time base, not the wall
+        # clock: sampled blobs may be hours/days old and relative int32
+        # times must stay small (production gets this for free — live
+        # batches are near now)
+        base_ms = self._sample_base_ms()
         raw = proc.encode_rows(self.sample_rows, (base_ms // 1000) * 1000)
         datasets, _metrics = proc.process_batch(raw, batch_time_ms=base_ms)
         rows = datasets.get(target, [])[:max_rows]

@@ -158,3 +158,58 @@ def test_schema_rejects_unsupported():
             "type": "struct",
             "fields": [{"name": "a", "type": {"type": "array", "elementType": "long"}}],
         }))
+
+
+def test_string_timestamps_parse_at_encode_boundary():
+    """stringToTimestamp role (BuiltInFunctionsHandler): string event
+    times become int32 relative ms; garbage stays null/zero."""
+    import json as _json
+
+    import numpy as np
+
+    from data_accelerator_tpu.core.batch import batch_from_rows, parse_timestamp_ms
+    from data_accelerator_tpu.core.schema import Schema, StringDictionary
+
+    assert parse_timestamp_ms("2024-03-01T10:00:00Z") == 1709287200000
+    assert parse_timestamp_ms("2024-03-01 10:00:00") == 1709287200000
+    assert parse_timestamp_ms("1709287200") == 1709287200000
+    assert parse_timestamp_ms("1709287200123") == 1709287200123
+    assert parse_timestamp_ms("not a date") is None
+
+    schema = Schema.from_spark_json(_json.dumps({
+        "type": "struct", "fields": [
+            {"name": "ts", "type": "timestamp", "nullable": False, "metadata": {}},
+            {"name": "v", "type": "long", "nullable": False, "metadata": {}},
+        ],
+    }))
+    d = StringDictionary()
+    b = batch_from_rows(
+        [
+            {"ts": "2024-03-01T10:00:05Z", "v": 1},
+            {"ts": "2024-03-01T10:00:00Z", "v": 2},
+            {"ts": "garbage", "v": 3},
+        ],
+        schema, 4, d, base_ms=1709287200000,
+    )
+    ts = np.asarray(b.columns["ts"])
+    assert ts[0] == 5000 and ts[1] == 0 and ts[2] == 0
+
+
+def test_far_timestamps_saturate_not_overflow():
+    import json as _json
+
+    import numpy as np
+
+    from data_accelerator_tpu.core.batch import batch_from_rows
+    from data_accelerator_tpu.core.schema import Schema, StringDictionary
+
+    schema = Schema.from_spark_json(_json.dumps({
+        "type": "struct", "fields": [
+            {"name": "ts", "type": "timestamp", "nullable": False, "metadata": {}},
+        ],
+    }))
+    b = batch_from_rows(
+        [{"ts": 1_700_000_000_000}], schema, 2, StringDictionary(),
+        base_ms=1_790_000_000_000,  # ~3 years later: clamps, no crash
+    )
+    assert np.asarray(b.columns["ts"])[0] == -(2**31)
