@@ -112,6 +112,14 @@ class DataXApi:
         path = path.strip("/")
         if path.startswith("api/"):
             path = path[len("api/"):]
+        # gateway/website-style paths carry the target service as the
+        # first segment (api/{service}/{route}); this single process
+        # serves all four service families, so drop it when present
+        head, _, rest = path.partition("/")
+        if head in (
+            "flow", "interactivequery", "schemainference", "livedata"
+        ) and (method.upper(), path) not in self.routes:
+            path = rest
         entry = self.routes.get((method.upper(), path))
         if entry is None:
             return 404, {"error": {"message": f"unknown route {method} {path}"}}

@@ -1,0 +1,175 @@
+"""Canned e2e scenario suites over the live REST API.
+
+reference: Tests/DataXScenarios/{SaveAndDeploy,
+InteractiveQueryAndSchemaGenScenarios}.cs — [Step]-attributed HTTP
+sequences sharing a ScenarioContext, run by ScenarioTester against a
+deployed instance and scheduled continuously by Services/JobRunner as
+the production liveness probe.
+
+Each builder returns a Scenario whose steps hit the given base URL
+(website or gateway; pass a bearer token for the gateway). Wire into
+JobRunner for the scheduled-probe role:
+
+    runner = JobRunner([save_and_deploy(url), schema_and_query(url)])
+    runner.start()
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from .scenario import Scenario, ScenarioContext
+
+_SCHEMA = json.dumps({"type": "struct", "fields": [
+    {"name": "deviceId", "type": "long", "nullable": False,
+     "metadata": {"allowedValues": [1, 2, 3]}},
+    {"name": "temperature", "type": "double", "nullable": False,
+     "metadata": {"minValue": 0, "maxValue": 100}},
+]})
+
+
+def _call(ctx: ScenarioContext, method: str, path: str, body=None):
+    url = f"{ctx['base_url'].rstrip('/')}{path}"
+    headers = {"Content-Type": "application/json"}
+    if ctx.get("token"):
+        headers["Authorization"] = f"Bearer {ctx['token']}"
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers=headers,
+        method=method,
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        payload = json.loads(r.read() or b"{}")
+    return payload.get("result", payload)
+
+
+def save_and_deploy(
+    base_url: str,
+    flow_name: str = "probe-deploy",
+    token: Optional[str] = None,
+    batches: int = 2,
+) -> Scenario:
+    """Save flow -> generate configs -> start -> jobs running -> stop ->
+    delete (reference: SaveAndDeploy.cs over FlowManagementController)."""
+    sc = Scenario(f"SaveAndDeploy")
+
+    @sc.step
+    def init_context(ctx):
+        ctx.setdefault("base_url", base_url)
+        ctx.setdefault("token", token)
+
+    @sc.step
+    def save_flow(ctx):
+        gui = {
+            "name": flow_name,
+            "displayName": "Probe Deploy",
+            "input": {"mode": "streaming", "type": "local", "properties": {
+                "inputSchemaFile": _SCHEMA,
+                "normalizationSnippet": "Raw.*",
+            }},
+            "process": {"queries": [
+                "--DataXQuery--\n"
+                "Hot = SELECT deviceId, temperature FROM DataXProcessedInput "
+                "WHERE temperature > 50"
+            ]},
+            "outputs": [{"id": "Hot", "type": "console", "properties": {}}],
+        }
+        r = _call(ctx, "POST", "/api/flow/flow/save", gui)
+        assert r.get("name") == flow_name, r
+
+    @sc.step
+    def generate_configs(ctx):
+        r = _call(ctx, "POST", "/api/flow/flow/generateconfigs",
+                  {"flowName": flow_name})
+        assert r.get("jobNames"), r
+        ctx["jobNames"] = r["jobNames"]
+
+    @sc.step
+    def start_jobs(ctx):
+        r = _call(ctx, "POST", "/api/flow/flow/startjobs",
+                  {"flowName": flow_name, "batches": batches})
+        assert len(r) == len(ctx["jobNames"]), r
+
+    @sc.step
+    def jobs_reach_terminal_state(ctx):
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            jobs = _call(ctx, "POST", "/api/flow/job/getbynames",
+                         {"jobNames": ctx["jobNames"]})
+            states = {j["name"]: j.get("state") for j in jobs if j}
+            if all(s in ("running", "idle", "starting") for s in states.values()):
+                if all(s == "idle" for s in states.values()):
+                    return  # finite-batch run completed
+            _call(ctx, "POST", "/api/flow/job/syncall", {})
+            time.sleep(1)
+        raise AssertionError(f"jobs never settled: {states}")
+
+    @sc.step
+    def stop_and_delete(ctx):
+        _call(ctx, "POST", "/api/flow/flow/stopjobs", {"flowName": flow_name})
+        r = _call(ctx, "POST", "/api/flow/flow/delete", {"flowName": flow_name})
+        assert r.get("deleted") is True, r
+
+    return sc
+
+
+def schema_and_query(
+    base_url: str,
+    flow_name: str = "probe-query",
+    token: Optional[str] = None,
+) -> Scenario:
+    """Infer schema from sampled events -> create kernel -> execute a
+    query -> recycle (reference: InteractiveQueryAndSchemaGenScenarios)."""
+    sc = Scenario("SchemaAndQuery")
+
+    @sc.step
+    def init_context(ctx):
+        ctx.setdefault("base_url", base_url)
+        ctx.setdefault("token", token)
+
+    @sc.step
+    def infer_schema(ctx):
+        events = [{"deviceId": i % 3, "temperature": 10.0 * i} for i in range(20)]
+        r = _call(ctx, "POST", "/api/schemainference/inputdata/inferschema",
+                  {"name": flow_name, "events": events})
+        schema = r.get("Schema") or r.get("schema")
+        assert schema, r
+        ctx["schema"] = schema if isinstance(schema, str) else json.dumps(schema)
+
+    @sc.step
+    def create_kernel(ctx):
+        r = _call(ctx, "POST", "/api/interactivequery/kernel",
+                  {"name": flow_name, "inputSchema": ctx["schema"]})
+        assert r.get("kernelId"), r
+        ctx["kernelId"] = r["kernelId"]
+
+    @sc.step
+    def execute_query(ctx):
+        r = _call(ctx, "POST", "/api/interactivequery/kernel/executequery", {
+            "kernelId": ctx["kernelId"],
+            "query": "--DataXQuery--\nT = SELECT deviceId, "
+                     "COUNT(*) AS c FROM DataXProcessedInput GROUP BY deviceId",
+            "maxRows": 10,
+        })
+        assert r.get("result"), r
+
+    @sc.step
+    def recycle_kernel(ctx):
+        r = _call(ctx, "POST", "/api/interactivequery/kernel/delete",
+                  {"kernelId": ctx["kernelId"]})
+        assert r.get("deleted") is True, r
+
+    return sc
+
+
+def default_suite(base_url: str, token: Optional[str] = None):
+    """The JobRunner's standing probe set (DataXDeployJob analog)."""
+    return [
+        save_and_deploy(base_url, token=token),
+        schema_and_query(base_url, token=token),
+    ]

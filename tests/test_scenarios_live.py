@@ -1,0 +1,55 @@
+"""Live-API scenario suites (DataXScenarios analog) against a real HTTP
+control plane — the reference's scheduled e2e probe path."""
+
+import pytest
+
+from data_accelerator_tpu.serve.flowservice import FlowOperation
+from data_accelerator_tpu.serve.jobrunner import JobRunner
+from data_accelerator_tpu.serve.restapi import DataXApi, DataXApiService
+from data_accelerator_tpu.serve.scenarios import (
+    default_suite,
+    save_and_deploy,
+    schema_and_query,
+)
+from data_accelerator_tpu.serve.storage import (
+    LocalDesignTimeStorage,
+    LocalRuntimeStorage,
+)
+
+
+@pytest.fixture()
+def live_api(tmp_path):
+    ops = FlowOperation(
+        LocalDesignTimeStorage(str(tmp_path / "design")),
+        LocalRuntimeStorage(str(tmp_path / "runtime")),
+    )
+    svc = DataXApiService(DataXApi(ops), port=0)
+    svc.start()
+    yield f"http://127.0.0.1:{svc.port}"
+    svc.stop()
+
+
+def test_schema_and_query_scenario_passes(live_api):
+    result = schema_and_query(live_api).run()
+    assert result.success, result.failed_step
+    assert [s.name for s in result.steps] == [
+        "init_context", "infer_schema", "create_kernel",
+        "execute_query", "recycle_kernel",
+    ]
+
+
+def test_save_and_deploy_scenario_passes(live_api):
+    result = save_and_deploy(live_api, batches=1).run()
+    assert result.success, (
+        result.failed_step,
+        [s.error for s in result.steps if not s.success],
+    )
+
+
+def test_jobrunner_runs_default_suite(live_api):
+    runner = JobRunner(default_suite(live_api))
+    results = runner.run_once()
+    assert [r.success for r in results] == [True, True]
+    assert {h["scenario"] for h in runner.history} == {
+        "SaveAndDeploy", "SchemaAndQuery"
+    }
