@@ -92,6 +92,7 @@ def batch_from_rows(
     capacity: int,
     dictionary: StringDictionary,
     base_ms: Optional[int] = None,
+    stats: Optional[Dict[str, int]] = None,
 ) -> Batch:
     """Host-side encode of JSON-like row dicts into a device batch.
 
@@ -99,8 +100,13 @@ def batch_from_rows(
     ``capacity`` are dropped (the runtime's ingest chunker prevents this).
     This is the pure-Python fallback path; the C++ decoder in
     ``native/`` produces the same buffers for the hot ingest path.
+
+    A row whose TIMESTAMP column holds an unparseable string is marked
+    invalid (not silently anchored at the batch base time); pass
+    ``stats`` to receive a ``bad_timestamps`` count for metrics.
     """
     n = min(len(rows), capacity)
+    bad_ts = np.zeros((capacity,), dtype=np.bool_)
     if base_ms is None:
         base_ms = 0
         for r in rows[:n]:
@@ -126,6 +132,10 @@ def batch_from_rows(
                     # columns never hold raw date strings
                     v = parse_timestamp_ms(v)
                     if v is None:
+                        # garbage timestamp: excluding the row beats
+                        # silently treating it as the batch base time
+                        # (which would window it wrongly)
+                        bad_ts[i] = True
                         continue
                 # relative ms saturate at the int32 range: a sample/replay
                 # row weeks away from the batch base clamps (~±24 days)
@@ -143,6 +153,11 @@ def batch_from_rows(
 
     valid = np.zeros((capacity,), dtype=np.bool_)
     valid[:n] = True
+    valid &= ~bad_ts
+    if stats is not None:
+        stats["bad_timestamps"] = (
+            stats.get("bad_timestamps", 0) + int(bad_ts.sum())
+        )
     return Batch(
         {k: jnp.asarray(v) for k, v in arrays.items()},
         jnp.asarray(valid),

@@ -94,6 +94,8 @@ def _load():
             ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_int64),
         ]
+        lib.dx_bad_timestamps.restype = ctypes.c_int64
+        lib.dx_bad_timestamps.argtypes = [ctypes.c_void_p]
         lib.dx_dict_size.restype = ctypes.c_int64
         lib.dx_dict_size.argtypes = [ctypes.c_void_p]
         lib.dx_dict_push.restype = ctypes.c_int32
@@ -127,6 +129,7 @@ class NativeDecoder:
         self._d = lib.dx_decoder_create(desc.encode("utf-8"))
         self._cols = list(schema.columns)
         self._synced = 0
+        self.last_bad_timestamps = 0
         self._push_python_entries()
 
     def close(self):
@@ -194,5 +197,6 @@ class NativeDecoder:
             self._d, data, len(data), max_rows, ptrs,
             valid.ctypes.data_as(ctypes.c_void_p), ctypes.byref(consumed),
         )
+        self.last_bad_timestamps = int(self._lib.dx_bad_timestamps(self._d))
         self._pull_native_entries()
         return arrays, valid.astype(bool), int(rows), int(consumed.value)

@@ -316,6 +316,9 @@ class FlowProcessor:
         }
         self._slot_counter = 0
         self._base_ms: Optional[int] = None
+        # host-side ingest counters (e.g. rows dropped for garbage
+        # timestamps), drained into metrics at each collect
+        self.ingest_stats: Dict[str, int] = {}
 
     # -- the jitted step --------------------------------------------------
     def _jit_step(self):
@@ -425,7 +428,8 @@ class FlowProcessor:
         from ..core.batch import batch_from_rows
 
         b = batch_from_rows(
-            rows, self.input_schema, self.batch_capacity, self.dictionary, base_ms
+            rows, self.input_schema, self.batch_capacity, self.dictionary,
+            base_ms, stats=self.ingest_stats,
         )
         cols = dict(b.columns)
         cols.setdefault(
@@ -468,14 +472,24 @@ class FlowProcessor:
         arrays, valid, rows, _consumed = self._native_decoder.decode(
             data, self.batch_capacity
         )
+        if self._native_decoder.last_bad_timestamps:
+            self.ingest_stats["bad_timestamps"] = (
+                self.ingest_stats.get("bad_timestamps", 0)
+                + self._native_decoder.last_bad_timestamps
+            )
         cap = self.batch_capacity
         cols: Dict[str, jnp.ndarray] = {}
         for col in self.input_schema.columns:
             a = arrays[col.name]
             if col.ctype == ColType.TIMESTAMP:
                 # slots the decoder left at 0 (field missing) stay at
-                # relative 0, matching the Python fallback encoder
-                a = np.where(a == 0, 0, a - np.int64(base_ms)).astype(np.int32)
+                # relative 0; deltas saturate at the int32 range like the
+                # Python encoder (core/batch.py) instead of wrapping
+                a = np.where(
+                    a == 0,
+                    np.int64(0),
+                    np.clip(a - np.int64(base_ms), -2**31, 2**31 - 1),
+                ).astype(np.int32)
             elif col.ctype == ColType.BOOLEAN:
                 a = a.astype(np.bool_)
             cols[col.name] = jnp.asarray(a)
@@ -549,6 +563,7 @@ class FlowProcessor:
         return PendingBatch(
             self, self.pipeline, out_datasets, new_state, counts_vec,
             batch_time_ms, new_base_ms, t0,
+            out_names=list(self.output_datasets),
         )
 
     def process_batch(
@@ -580,12 +595,19 @@ class PendingBatch:
     def __init__(
         self, proc: "FlowProcessor", pipeline, out_datasets, state,
         counts_vec, batch_time_ms: int, base_ms: int, t0: float,
+        out_names: Optional[List[str]] = None,
     ):
         self.proc = proc
         # THIS batch's pipeline: a UDF onInterval refresh may rebuild
         # proc.pipeline before an in-flight batch collects; its outputs
         # must decode against the schemas of the step that produced them
         self.pipeline = pipeline
+        # likewise the dataset-name order the step packed counts in — a
+        # refresh can reorder/shrink proc.output_datasets mid-flight
+        self.out_names = (
+            list(out_names) if out_names is not None
+            else list(proc.output_datasets)
+        )
         self.out_datasets = out_datasets
         self.state = state  # THIS batch's state, for the A/B overwrite
         self.counts_vec = counts_vec
@@ -614,10 +636,10 @@ class PendingBatch:
             counts = np.asarray(self.counts_vec)
             host_full = None
         input_count = int(counts[0])
-        # unpack in PACKING order (proc.output_datasets) — jax returns
+        # unpack in PACKING order (snapshotted at dispatch) — jax returns
         # dict pytrees with sorted keys, so iterating out_datasets may
         # not match the order the step packed counts in
-        names = list(proc.output_datasets)
+        names = self.out_names
         dataset_counts = {
             n: int(counts[1 + i]) for i, n in enumerate(names)
         }
@@ -666,4 +688,10 @@ class PendingBatch:
             metrics[f"Output_{n}_Events_Count"] = float(c)
         for n, c in dropped_groups.items():
             metrics[f"Output_{n}_GroupsDropped"] = float(c)
+        # drain host-side ingest counters accumulated since last collect
+        if proc.ingest_stats:
+            for k, v in proc.ingest_stats.items():
+                if v:
+                    metrics[f"Input_{k}_Count"] = float(v)
+            proc.ingest_stats.clear()
         return datasets, metrics

@@ -607,8 +607,17 @@ class KafkaSource(StreamingSource):
                 from kafka import TopicPartition  # type: ignore
                 from kafka.structs import OffsetAndMetadata  # type: ignore
 
+                # kafka-python-ng adds a required leader_epoch field to
+                # the OffsetAndMetadata namedtuple; build by arity so
+                # commits don't silently TypeError on the maintained fork
+                if len(getattr(OffsetAndMetadata, "_fields", ())) >= 3:
+                    def _om(until):
+                        return OffsetAndMetadata(until, None, -1)
+                else:
+                    def _om(until):
+                        return OffsetAndMetadata(until, None)
                 self._consumer.commit({
-                    TopicPartition(t, p): OffsetAndMetadata(until, None)
+                    TopicPartition(t, p): _om(until)
                     for (t, p), (_frm, until) in offsets.items()
                 })
             elif self._flavor == "confluent":
@@ -620,10 +629,15 @@ class KafkaSource(StreamingSource):
                 ], asynchronous=True)
             else:
                 self._consumer.commit(offsets)
+            # a success re-arms the warning so a NEW failure episode
+            # (e.g. ACL revoked weeks later) is not silently muted
+            self._commit_warned = False
         except Exception as e:  # noqa: BLE001 — commit is best-effort;
             # at-least-once comes from the in-flight FIFO, commit only
             # narrows the cross-restart replay window
-            logger.warning("kafka commit failed: %s", e)
+            if not getattr(self, "_commit_warned", False):
+                self._commit_warned = True
+                logger.warning("kafka commit failed (muting repeats): %s", e)
 
     def close(self) -> None:
         try:

@@ -98,13 +98,16 @@ def save_and_deploy(
     @sc.step
     def jobs_reach_terminal_state(ctx):
         deadline = time.time() + 60
+        states = {}
         while time.time() < deadline:
             jobs = _call(ctx, "POST", "/api/flow/job/getbynames",
                          {"jobNames": ctx["jobNames"]})
             states = {j["name"]: j.get("state") for j in jobs if j}
-            if all(s in ("running", "idle", "starting") for s in states.values()):
-                if all(s == "idle" for s in states.values()):
-                    return  # finite-batch run completed
+            if states and all(s in ("idle", "success")
+                              for s in states.values()):
+                return  # finite-batch run completed
+            if any(s == "error" for s in states.values()):
+                raise AssertionError(f"job failed: {states}")
             _call(ctx, "POST", "/api/flow/job/syncall", {})
             time.sleep(1)
         raise AssertionError(f"jobs never settled: {states}")

@@ -100,6 +100,7 @@ def write_with_timeout_and_retries(
     retries (the caller's batch try/except owns the retry-batch policy).
     """
     last_err: Optional[BaseException] = None
+    orphans: List[threading.Thread] = []
     for attempt in range(1, retries + 1):
         done = threading.Event()
         abort = threading.Event()
@@ -116,9 +117,15 @@ def write_with_timeout_and_retries(
         t = threading.Thread(target=attempt_write, daemon=True)
         t.start()
         if not done.wait(timeout_s):
-            # the orphan writes a unique temp and checks `abort` before its
-            # rename, so it can't install data after we've moved on
+            # the orphan writes a unique temp and checks `abort` before
+            # its rename. NOTE: an orphan that passes the check just
+            # before abort.set() can still rename afterwards — the
+            # window is narrowed, not closed. Within this call that is
+            # harmless (every attempt writes identical bytes); writers
+            # of *different* content to the same path must serialize
+            # externally (the sink dispatcher does).
             abort.set()
+            orphans.append(t)
             last_err = TimeoutError(
                 f"write of {path} exceeded {timeout_s}s (attempt {attempt})"
             )
@@ -130,6 +137,9 @@ def write_with_timeout_and_retries(
                 "write of %s failed (attempt %d): %s", path, attempt, last_err
             )
             continue
+        # best-effort: drain straggler attempts so none outlives success
+        for o in orphans:
+            o.join(timeout=0.1)
         return True
     assert last_err is not None
     raise last_err

@@ -45,6 +45,7 @@ struct Decoder {
   std::unordered_map<std::string, int32_t> dict;
   std::vector<std::string> dict_entries;  // id -> string
   std::string err;
+  int64_t bad_ts_count = 0;  // rows dropped for garbage timestamps (last decode)
 };
 
 struct OutBufs {
@@ -205,6 +206,7 @@ struct ParseCtx {
   int64_t row;
   std::string path;      // reusable dotted-path buffer
   std::string sbuf;      // reusable string scratch
+  bool bad_ts = false;   // row hit an unparseable string timestamp
 };
 
 void store_scalar(ParseCtx& ctx, int32_t ci, Cursor& c) {
@@ -283,7 +285,36 @@ void store_scalar(ParseCtx& ctx, int32_t ci, Cursor& c) {
         if (!parse_string(c, ctx.sbuf)) return;
         bool ok = false;
         ms = parse_iso8601_ms(ctx.sbuf, &ok);
-        if (!ok) ms = (int64_t)atof(ctx.sbuf.c_str());
+        if (!ok) {
+          // bare epoch digits, with the same digits-only acceptance and
+          // seconds-vs-millis heuristic as the Python encode path
+          // (core/batch.py parse_timestamp_ms: strip, then
+          // s.replace('.','',1).isdigit()); anything else — including
+          // 'nan'/'inf'/hex/exponent/sign forms strtod would take —
+          // invalidates the row, since silently anchoring it at time 0
+          // would window it wrongly
+          size_t b = ctx.sbuf.find_first_not_of(" \t\r\n");
+          size_t e = ctx.sbuf.find_last_not_of(" \t\r\n");
+          bool digits = (b != std::string::npos);
+          int dots = 0;
+          for (size_t i = b; digits && i <= e; ++i) {
+            char dc = ctx.sbuf[i];
+            if (dc == '.') {
+              if (++dots > 1) digits = false;
+            } else if (dc < '0' || dc > '9') {
+              digits = false;
+            }
+          }
+          // a lone '.' has no digits; mirror isdigit() == false
+          if (digits && e - b + 1 == (size_t)dots) digits = false;
+          if (digits) {
+            double v = strtod(ctx.sbuf.c_str() + b, nullptr);
+            ms = (v > 1e12) ? (int64_t)v : (int64_t)(v * 1000.0);
+          } else {
+            ctx.bad_ts = true;
+            return;
+          }
+        }
       } else {
         bool ok = false;
         double v = parse_number(c, &ok);
@@ -407,6 +438,7 @@ int64_t dx_decode(void* dv, const char* buf, int64_t len, int64_t max_rows,
   const char* end = buf + len;
   const char* line_start = p;
   int64_t rows = 0;
+  d->bad_ts_count = 0;
   while (p < end && rows < max_rows) {
     const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
     const char* line_end = nl ? nl : end;
@@ -415,10 +447,12 @@ int64_t dx_decode(void* dv, const char* buf, int64_t len, int64_t max_rows,
     if (c.p < c.end && *c.p == '{') {
       ctx.row = rows;
       ctx.path.clear();
-      if (parse_object(ctx, c)) {
+      ctx.bad_ts = false;
+      if (parse_object(ctx, c) && !ctx.bad_ts) {
         valid[rows] = 1;
         ++rows;
       } else {
+        if (ctx.bad_ts) ++d->bad_ts_count;
         zero_row(d, &out, rows);
       }
     }
@@ -433,6 +467,12 @@ int64_t dx_decode(void* dv, const char* buf, int64_t len, int64_t max_rows,
   }
   if (consumed) *consumed = line_start - buf;
   return rows;
+}
+
+// Rows dropped by the last dx_decode because a string timestamp was
+// unparseable (matches the Python encoder's bad_timestamps stat).
+int64_t dx_bad_timestamps(void* dv) {
+  return static_cast<Decoder*>(dv)->bad_ts_count;
 }
 
 // ---- dictionary sync -------------------------------------------------

@@ -176,3 +176,66 @@ def test_processor_encode_json_bytes(tmp_path):
     got = sorted((r["deviceId"], r["temperature"]) for r in datasets["Hot"])
     assert got == [(2, 60.0), (3, 70.0)]
     assert metrics["Input_DataXProcessedInput_Events_Count"] == 4.0
+
+
+def test_bad_string_timestamp_drops_row_and_counts():
+    """Garbage string timestamps invalidate the row on BOTH encode
+    paths (C++ and Python) instead of silently anchoring it at the
+    batch base time, and the drop is counted for metrics."""
+    dd = StringDictionary()
+    dec = NativeDecoder(SCHEMA, dd)
+    mk = lambda i, ts: json.dumps({
+        "deviceDetails": {"deviceId": i, "deviceType": "X",
+                          "temperature": 1.0, "online": True},
+        "eventTime": ts,
+    }).encode()
+    lines = b"\n".join([
+        mk(0, 1_700_000_000),          # good epoch seconds
+        mk(1, "not-a-date"),           # garbage -> dropped
+        mk(2, "1700000123"),           # digit string, sec heuristic
+        mk(3, "2023-11-14T22:13:20Z"),  # ISO
+    ]) + b"\n"
+    cols, valid, rows, _ = dec.decode(lines, 8)
+    assert rows == 3
+    assert dec.last_bad_timestamps == 1
+    np.testing.assert_array_equal(cols["deviceDetails.deviceId"][:3], [0, 2, 3])
+    assert cols["eventTime"][1] == 1_700_000_123_000  # sec->ms heuristic
+    assert cols["eventTime"][2] == 1_700_000_000_000  # ISO parse
+
+    # python fallback path: same semantics + stats counter
+    from data_accelerator_tpu.core.batch import batch_from_rows
+    stats = {}
+    b = batch_from_rows(
+        [json.loads(mk(0, 1_700_000_000)), json.loads(mk(1, "junk"))],
+        SCHEMA, capacity=4, dictionary=dd, base_ms=0, stats=stats,
+    )
+    v = np.asarray(b.valid)
+    assert v[0] and not v[1]
+    assert stats["bad_timestamps"] == 1
+
+
+def test_string_timestamp_python_parity_edge_cases():
+    """strtod-isms the Python parser rejects must be rejected natively
+    too: nan/inf/hex/exponent/sign forms drop the row; padded digit
+    strings are accepted (core/batch.py parse_timestamp_ms parity)."""
+    dd = StringDictionary()
+    dec = NativeDecoder(SCHEMA, dd)
+    mk = lambda i, ts: json.dumps({
+        "deviceDetails": {"deviceId": i, "deviceType": "X",
+                          "temperature": 1.0, "online": True},
+        "eventTime": ts,
+    }).encode()
+    bad = ["NaN", "inf", "0x1A", "1e5", "-5", "", ".", "1.2.3"]
+    good = [(" 1700000123 ", 1_700_000_123_000),
+            ("1700000123456", 1_700_000_123_456),
+            ("1700000123.5", 1_700_000_123_500)]
+    lines = b"\n".join(
+        [mk(i, ts) for i, ts in enumerate(bad)]
+        + [mk(100 + i, ts) for i, (ts, _) in enumerate(good)]
+    ) + b"\n"
+    cols, valid, rows, _ = dec.decode(lines, 16)
+    assert rows == len(good)
+    assert dec.last_bad_timestamps == len(bad)
+    for i, (_, want_ms) in enumerate(good):
+        assert cols["deviceDetails.deviceId"][i] == 100 + i
+        assert cols["eventTime"][i] == want_ms

@@ -107,7 +107,11 @@ def test_onebox_flow_runs(flow_conf):
     input_key = "DATAX-OneBoxTest:Input_DataXProcessedInput_Events_Count"
     points = store.points(input_key)
     assert len(points) == 3
-    assert all(p["val"] == 50.0 for p in points)
+    # maxRate*interval = 50 is the ceiling; a slow batch (e.g. the first
+    # one's jit compile) may halve the next poll via adaptive
+    # backpressure, so later batches can legitimately carry fewer events
+    assert all(0 < p["val"] <= 50.0 for p in points)
+    assert points[0]["val"] == 50.0  # first poll always at full rate
     assert store.points("DATAX-OneBoxTest:Latency-Batch")
 
     # rule expansion produced the OPENAlert metric table -> store keys
