@@ -25,6 +25,7 @@ representation is TPU-first.
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -41,9 +42,18 @@ from .sqlparser import (
     Func,
     InList,
     IsNull,
+    LikeOp,
     Literal,
     Star,
     UnaryOp,
+)
+from .stringops import (
+    RANK_KEY,
+    AuxRegistry,
+    like_to_regex,
+    spark_instr,
+    spark_split_at,
+    spark_substring,
 )
 
 AGGREGATE_FNS = {"AVG", "MIN", "MAX", "SUM", "COUNT"}
@@ -215,10 +225,14 @@ class ExprCompiler:
         scope: Scope,
         dictionary: StringDictionary,
         udfs: Optional[dict] = None,
+        aux: Optional[AuxRegistry] = None,
     ):
         self.scope = scope
         self.dictionary = dictionary
         self.udfs = udfs or {}
+        # dictionary-table registry for device string ops; shared across
+        # every compiler of one flow (see compile/stringops.py)
+        self.aux = aux if aux is not None else AuxRegistry()
 
     # -- public ----------------------------------------------------------
     def compile(self, e: Expr) -> Value:
@@ -240,6 +254,8 @@ class ExprCompiler:
             return self._case(e)
         if isinstance(e, IsNull):
             return self._is_null(e)
+        if isinstance(e, LikeOp):
+            return self._like(e)
         if isinstance(e, Star):
             raise EngineException("* only allowed as a top-level select item")
         raise EngineException(f"unsupported expression {e!r}")
@@ -333,7 +349,33 @@ class ExprCompiler:
         if ("string" in (lt, rt)) and lt != rt:
             raise EngineException(f"cannot compare {lt} with {rt}")
         if lt == "string" and op not in ("=", "!="):
-            raise EngineException("string ordering comparisons are not supported")
+            # lexicographic ordering via the dictionary rank table:
+            # rank[id] is the string's position in sorted order, so
+            # integer comparison of ranks IS string comparison. A NULL
+            # operand (id 0) makes the comparison NULL -> false.
+            self.aux.require_rank()
+            import operator as _op
+
+            f = {"<": _op.lt, "<=": _op.le, ">": _op.gt, ">=": _op.ge}[op]
+
+            def run_rank(env, l=l, r=r, f=f):
+                t = env.scopes["__aux"][RANK_KEY]
+                hi = t.shape[0] - 1
+                a, b = l.fn(env), r.fn(env)
+                ra = t[jnp.clip(a, 0, hi)]
+                rb = t[jnp.clip(b, 0, hi)]
+                return f(ra, rb) & (a != 0) & (b != 0)
+
+            return CompiledExpr("boolean", run_rank, deps=l.deps + r.deps)
+        if lt == "string":
+            # = / != with SQL null semantics: NULL compares as NULL ->
+            # false either way (ids are exact string identity otherwise)
+            def run_eq(env, l=l, r=r, eq=(op == "=")):
+                a, b = l.fn(env), r.fn(env)
+                nn = (a != 0) & (b != 0)
+                return ((a == b) if eq else (a != b)) & nn
+
+            return CompiledExpr("boolean", run_eq, deps=l.deps + r.deps)
         # timestamp/tssec comparisons: both sides share the batch base, so
         # relative values compare exactly
         cast = None
@@ -444,11 +486,98 @@ class ExprCompiler:
         return CompiledExpr(out_t, run, deps=deps)
 
     def _is_null(self, e: IsNull) -> Value:
-        # row-validity handles nulls; a present device value is non-null
+        # strings carry a real null (dictionary id 0); for other types
+        # row-validity is the null mechanism, so present values are
+        # non-null
+        v = self.compile(e.expr)
+        if is_device(v) and v.type == "string":
+            def run(env, v=v, neg=e.negated):
+                ids = v.fn(env)
+                return (ids != 0) if neg else (ids == 0)
+
+            return CompiledExpr("boolean", run, deps=v.deps)
         val = bool(e.negated)
         return CompiledExpr(
             "boolean", lambda env, v=val: jnp.broadcast_to(jnp.asarray(v), env.shape)
         )
+
+    # -- dictionary-table string ops (compile/stringops.py) ---------------
+    def _const_str(self, e: Expr, what: str) -> str:
+        if isinstance(e, Literal) and e.kind == "str":
+            return e.value
+        raise EngineException(f"{what} must be a string literal, got {e!r}")
+
+    def _const_int(self, e: Expr, what: str) -> int:
+        if isinstance(e, Literal) and e.kind == "int":
+            return e.value
+        if isinstance(e, UnaryOp) and e.op == "-" \
+                and isinstance(e.operand, Literal) and e.operand.kind == "int":
+            return -e.operand.value
+        raise EngineException(f"{what} must be an integer literal, got {e!r}")
+
+    def _string_arg(self, e: Expr, fname: str) -> CompiledExpr:
+        v = self.compile(e)
+        if isinstance(v, HostStr):
+            raise EngineException(
+                f"{fname} over a deferred string (CONCAT/CAST result) is "
+                "not supported on device — apply string functions to the "
+                "columns before concatenating"
+            )
+        if not is_device(v) or v.type != "string":
+            raise EngineException(f"{fname} expects a string argument, got {e!r}")
+        return v
+
+    def _aux_gather(
+        self, key: str, kind: str, host_fn, arg: CompiledExpr, out_type: str
+    ) -> CompiledExpr:
+        """Register a dictionary table and compile to a device gather."""
+        self.aux.register(key, kind, host_fn)
+
+        def run(env, key=key, arg=arg):
+            t = env.scopes["__aux"][key]
+            ids = arg.fn(env)
+            return t[jnp.clip(ids, 0, t.shape[0] - 1)]
+
+        return CompiledExpr(out_type, run, deps=arg.deps)
+
+    def _string_map(self, fname: str, e_arg: Expr, key: str, host_fn) -> Value:
+        return self._aux_gather(
+            f"map:{key}", "map", host_fn, self._string_arg(e_arg, fname), "string"
+        )
+
+    def _string_pred(self, fname: str, e_arg: Expr, key: str, host_fn) -> Value:
+        return self._aux_gather(
+            f"pred:{key}", "pred", host_fn, self._string_arg(e_arg, fname), "boolean"
+        )
+
+    def _string_scalar(self, fname: str, e_arg: Expr, key: str, host_fn) -> Value:
+        return self._aux_gather(
+            f"scalar:{key}", "scalar", host_fn, self._string_arg(e_arg, fname), "long"
+        )
+
+    def _like(self, e: LikeOp) -> Value:
+        pattern = self._const_str(e.pattern, "LIKE/RLIKE pattern")
+        if e.regex:
+            rx = re.compile(pattern)
+            key = f"RLIKE:{pattern}"
+            fn = lambda s, rx=rx: rx.search(s) is not None  # noqa: E731
+        else:
+            rx = re.compile(like_to_regex(pattern), re.DOTALL)
+            key = f"LIKE:{pattern}"
+            fn = lambda s, rx=rx: rx.fullmatch(s) is not None  # noqa: E731
+        pred = self._string_pred("LIKE", e.expr, key, fn)
+        if not e.negated:
+            return pred
+        # NOT LIKE: null stays excluded (pred[null]=False either way is
+        # SQL-correct for WHERE: NULL NOT LIKE p is NULL, not TRUE) — we
+        # negate the table-level result but force null ids to False
+        arg = self._string_arg(e.expr, "NOT LIKE")
+
+        def run(env, pred=pred, arg=arg):
+            ids = arg.fn(env)
+            return jnp.logical_not(pred.fn(env)) & (ids != 0)
+
+        return CompiledExpr("boolean", run, deps=pred.deps)
 
     def _cast(self, e: Cast) -> Value:
         target = e.target
@@ -592,10 +721,10 @@ class ExprCompiler:
             secs = {"second": 1, "minute": 60, "hour": 3600, "day": 86400}.get(unit)
             if secs is None:
                 raise EngineException(f"unsupported DATE_TRUNC unit {unit}")
+            abs_s = self._abs_seconds(ts)
 
-            def run(env, ts=ts, secs=secs):
-                rel = ts.fn(env)
-                total_s = env.base_s + rel // 1000
+            def run(env, abs_s=abs_s, secs=secs):
+                total_s = abs_s(env)
                 trunc_s = total_s - total_s % secs
                 return ((trunc_s - env.base_s) * 1000).astype(jnp.int32)
 
@@ -604,10 +733,10 @@ class ExprCompiler:
             ts = self._as_device(e.args[0])
             div = {"HOUR": 3600, "MINUTE": 60, "SECOND": 1}[name]
             mod = {"HOUR": 24, "MINUTE": 60, "SECOND": 60}[name]
+            abs_s = self._abs_seconds(ts)
 
-            def run(env, ts=ts, div=div, mod=mod):
-                rel = ts.fn(env)
-                total_s = env.base_s + rel // 1000
+            def run(env, abs_s=abs_s, div=div, mod=mod):
+                total_s = abs_s(env)
                 return ((total_s // div) % mod).astype(jnp.int32)
 
             return CompiledExpr("long", run, deps=ts.deps)
@@ -631,9 +760,281 @@ class ExprCompiler:
 
             return CompiledExpr(out_t, run, deps=v.deps)
 
+        v = self._string_func(e)
+        if v is not None:
+            return v
+        v = self._date_func(e)
+        if v is not None:
+            return v
+
         # UDF tiers
         lowered = name.lower()
         if lowered in self.udfs:
             return self.udfs[lowered].compile_call(self, e)
 
         raise EngineException(f"unknown function {name}")
+
+    # -- string function library (dictionary tables) ----------------------
+    _SIMPLE_MAPS = {
+        "UPPER": str.upper, "UCASE": str.upper,
+        "LOWER": str.lower, "LCASE": str.lower,
+        "TRIM": str.strip, "LTRIM": str.lstrip, "RTRIM": str.rstrip,
+        "REVERSE": lambda s: s[::-1],
+        "INITCAP": lambda s: " ".join(
+            w[:1].upper() + w[1:].lower() for w in s.split(" ")
+        ),
+    }
+
+    def _string_func(self, e: Func) -> Optional[Value]:
+        """Spark string functions lowered to dictionary-table gathers.
+
+        Semantics match Spark SQL (the engine behind the reference's
+        ``spark.sql`` calls): 1-based positions, clamped SUBSTRING,
+        NULL in -> NULL/false/0 out. Constant arguments are required
+        wherever the table is keyed on them (patterns, positions).
+        """
+        name, args = e.name, e.args
+        if name in self._SIMPLE_MAPS:
+            return self._string_map(name, args[0], name, self._SIMPLE_MAPS[name])
+        if name in ("LENGTH", "CHAR_LENGTH", "CHARACTER_LENGTH", "LEN"):
+            return self._string_scalar("LENGTH", args[0], "LENGTH", len)
+        if name in ("SUBSTRING", "SUBSTR"):
+            pos = self._const_int(args[1], "SUBSTRING position")
+            ln = (
+                self._const_int(args[2], "SUBSTRING length")
+                if len(args) > 2 else None
+            )
+            return self._string_map(
+                name, args[0], f"SUBSTRING:{pos}:{ln}",
+                lambda s, pos=pos, ln=ln: spark_substring(s, pos, ln),
+            )
+        if name == "REPLACE":
+            search = self._const_str(args[1], "REPLACE search")
+            repl = self._const_str(args[2], "REPLACE replacement") \
+                if len(args) > 2 else ""
+            return self._string_map(
+                name, args[0], f"REPLACE:{search!r}:{repl!r}",
+                lambda s, a=search, b=repl: s.replace(a, b),
+            )
+        if name == "TRANSLATE":
+            frm = self._const_str(args[1], "TRANSLATE from")
+            to = self._const_str(args[2], "TRANSLATE to")
+            tbl = str.maketrans(frm[: len(to)], to[: len(frm)], frm[len(to):])
+            return self._string_map(
+                name, args[0], f"TRANSLATE:{frm!r}:{to!r}",
+                lambda s, tbl=tbl: s.translate(tbl),
+            )
+        if name == "INSTR":
+            sub = self._const_str(args[1], "INSTR substring")
+            return self._string_scalar(
+                name, args[0], f"INSTR:{sub!r}",
+                lambda s, sub=sub: spark_instr(s, sub),
+            )
+        if name == "LOCATE":
+            # LOCATE(substr, str[, pos]) — note the flipped arg order
+            sub = self._const_str(args[0], "LOCATE substring")
+            start = self._const_int(args[2], "LOCATE pos") if len(args) > 2 else 1
+            return self._string_scalar(
+                name, args[1], f"LOCATE:{sub!r}:{start}",
+                lambda s, sub=sub, p=start: s.find(sub, max(0, p - 1)) + 1,
+            )
+        if name == "CONTAINS":
+            sub = self._const_str(args[1], "CONTAINS substring")
+            return self._string_pred(
+                name, args[0], f"CONTAINS:{sub!r}", lambda s, sub=sub: sub in s
+            )
+        if name in ("STARTSWITH", "STARTS_WITH"):
+            sub = self._const_str(args[1], "STARTSWITH prefix")
+            return self._string_pred(
+                name, args[0], f"STARTSWITH:{sub!r}",
+                lambda s, sub=sub: s.startswith(sub),
+            )
+        if name in ("ENDSWITH", "ENDS_WITH"):
+            sub = self._const_str(args[1], "ENDSWITH suffix")
+            return self._string_pred(
+                name, args[0], f"ENDSWITH:{sub!r}",
+                lambda s, sub=sub: s.endswith(sub),
+            )
+        if name == "REGEXP_EXTRACT":
+            pat = self._const_str(args[1], "REGEXP_EXTRACT pattern")
+            idx = self._const_int(args[2], "REGEXP_EXTRACT group") \
+                if len(args) > 2 else 1
+            rx = re.compile(pat)
+
+            def rex(s, rx=rx, idx=idx):
+                m = rx.search(s)
+                if m is None:
+                    return ""  # Spark returns empty string on no match
+                try:
+                    return m.group(idx) or ""
+                except (IndexError, re.error):
+                    return ""
+
+            return self._string_map(
+                name, args[0], f"REGEXP_EXTRACT:{pat!r}:{idx}", rex
+            )
+        if name == "REGEXP_REPLACE":
+            pat = self._const_str(args[1], "REGEXP_REPLACE pattern")
+            repl = self._const_str(args[2], "REGEXP_REPLACE replacement")
+            rx = re.compile(pat)
+            # Spark uses Java's $1 group refs; Python uses \1
+            py_repl = re.sub(r"\$(\d+)", r"\\\1", repl)
+            return self._string_map(
+                name, args[0], f"REGEXP_REPLACE:{pat!r}:{repl!r}",
+                lambda s, rx=rx, r=py_repl: rx.sub(r, s),
+            )
+        if name in ("LPAD", "RPAD"):
+            ln = self._const_int(args[1], f"{name} length")
+            pad = self._const_str(args[2], f"{name} pad") if len(args) > 2 else " "
+
+            def dopad(s, ln=ln, pad=pad, left=(name == "LPAD")):
+                if len(s) >= ln:
+                    return s[:ln]
+                fill = (pad * ln)[: ln - len(s)]
+                return fill + s if left else s + fill
+
+            return self._string_map(name, args[0], f"{name}:{ln}:{pad!r}", dopad)
+        if name == "SPLIT_PART":
+            delim = self._const_str(args[1], "SPLIT_PART delimiter")
+            idx = self._const_int(args[2], "SPLIT_PART index")
+            return self._string_map(
+                name, args[0], f"SPLIT_PART:{delim!r}:{idx}",
+                lambda s, d=delim, i=idx: spark_split_at(s, re.escape(d), i),
+            )
+        if name == "ELEMENT_AT" and args and isinstance(args[0], Func) \
+                and args[0].name == "SPLIT":
+            # element_at(split(s, regex), i): the composed function is one
+            # dictionary table — SPLIT alone (an array) has no device form
+            inner = args[0]
+            delim = self._const_str(inner.args[1], "SPLIT delimiter")
+            idx = self._const_int(args[1], "ELEMENT_AT index")
+            return self._string_map(
+                "SPLIT", inner.args[0], f"SPLIT_AT:{delim!r}:{idx}",
+                lambda s, d=delim, i=idx: spark_split_at(s, d, i),
+            )
+        if name == "SPLIT":
+            raise EngineException(
+                "SPLIT returns an array; use ELEMENT_AT(SPLIT(s, d), i) or "
+                "SPLIT_PART(s, d, i) to take one element"
+            )
+        if name == "CONCAT_WS":
+            sep = self._const_str(args[0], "CONCAT_WS separator")
+            parts: List[Union[str, CompiledExpr]] = []
+            deps: Tuple[Tuple[str, str], ...] = ()
+            for i, a in enumerate(args[1:]):
+                if i:
+                    parts.append(sep)
+                v = self.compile(a)
+                if isinstance(v, HostStr):
+                    parts.extend(v.parts)
+                    deps += v.deps
+                elif isinstance(v, CompiledExpr):
+                    if isinstance(a, Literal) and a.kind == "str":
+                        parts.append(a.value)
+                    else:
+                        parts.append(v)
+                        deps += v.deps
+                else:
+                    raise EngineException("CONCAT_WS of composite values unsupported")
+            return HostStr(parts, deps)
+        return None
+
+    # -- date/time function library ---------------------------------------
+    def _abs_seconds(self, ts: CompiledExpr):
+        """env -> absolute epoch seconds; honors the two time encodings
+        (timestamp = relative ms, tssec = relative s)."""
+        if ts.type == "tssec":
+            return lambda env, ts=ts: env.base_s + ts.fn(env)
+        if ts.type != "timestamp":
+            raise EngineException(
+                f"expected a timestamp-typed expression, got {ts.type}"
+            )
+        return lambda env, ts=ts: env.base_s + ts.fn(env) // 1000
+
+    def _civil(self, ts: CompiledExpr):
+        """(year, month, day) from a timestamp expr, UTC proleptic
+        Gregorian (Howard Hinnant's civil_from_days, pure int32 math —
+        no data-dependent control flow, fuses into the surrounding XLA
+        program)."""
+        abs_s = self._abs_seconds(ts)
+
+        def parts(env, abs_s=abs_s):
+            total_s = abs_s(env)
+            days = jnp.floor_divide(total_s, 86400)
+            z = days + 719468
+            era = jnp.floor_divide(z, 146097)
+            doe = z - era * 146097
+            yoe = jnp.floor_divide(
+                doe - doe // 1460 + doe // 36524 - doe // 146096, 365
+            )
+            y = yoe + era * 400
+            doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+            mp = jnp.floor_divide(5 * doy + 2, 153)
+            day = doy - jnp.floor_divide(153 * mp + 2, 5) + 1
+            month = mp + jnp.where(mp < 10, 3, -9)
+            year = y + (month <= 2)
+            return year.astype(jnp.int32), month.astype(jnp.int32), day.astype(jnp.int32)
+
+        return parts
+
+    def _date_func(self, e: Func) -> Optional[Value]:
+        name, args = e.name, e.args
+        if name in ("YEAR", "MONTH", "DAY", "DAYOFMONTH"):
+            ts = self._as_device(args[0])
+            if ts.type not in ("timestamp", "tssec"):
+                raise EngineException(f"{name} expects a timestamp")
+            parts = self._civil(ts)
+            pick = {"YEAR": 0, "MONTH": 1, "DAY": 2, "DAYOFMONTH": 2}[name]
+            return CompiledExpr(
+                "long", lambda env, parts=parts, pick=pick: parts(env)[pick],
+                deps=ts.deps,
+            )
+        if name == "DAYOFWEEK":
+            # Spark: 1 = Sunday .. 7 = Saturday; epoch day 0 is a Thursday
+            ts = self._as_device(args[0])
+            abs_s = self._abs_seconds(ts)
+
+            def dow(env, abs_s=abs_s):
+                days = jnp.floor_divide(abs_s(env), 86400)
+                return (jnp.mod(days + 4, 7) + 1).astype(jnp.int32)
+
+            return CompiledExpr("long", dow, deps=ts.deps)
+        if name == "DATEDIFF":
+            a = self._as_device(args[0])
+            b = self._as_device(args[1])
+            abs_a, abs_b = self._abs_seconds(a), self._abs_seconds(b)
+
+            def diff(env, abs_a=abs_a, abs_b=abs_b):
+                da = jnp.floor_divide(abs_a(env), 86400)
+                db = jnp.floor_divide(abs_b(env), 86400)
+                return (da - db).astype(jnp.int32)
+
+            return CompiledExpr("long", diff, deps=a.deps + b.deps)
+        if name == "TO_DATE":
+            ts = self._as_device(args[0])
+            abs_s = self._abs_seconds(ts)
+
+            def trunc_day(env, abs_s=abs_s):
+                total_s = abs_s(env)
+                t = total_s - jnp.mod(total_s, 86400)
+                return ((t - env.base_s) * 1000).astype(jnp.int32)
+
+            return CompiledExpr("timestamp", trunc_day, deps=ts.deps)
+        if name == "FROM_UNIXTIME":
+            # Spark returns a formatted string; here it stays a timestamp
+            # (the host renders it at the sink boundary) — comparisons and
+            # windowing on the result are exact either way
+            v = self._as_device(args[0])
+            if v.type == "tssec":  # already batch-relative seconds
+                return CompiledExpr(
+                    "timestamp",
+                    lambda env, v=v: (v.fn(env) * 1000).astype(jnp.int32),
+                    deps=v.deps,
+                )
+
+            def from_unix(env, v=v):  # absolute epoch seconds
+                secs = v.fn(env).astype(jnp.int32)
+                return ((secs - env.base_s) * 1000).astype(jnp.int32)
+
+            return CompiledExpr("timestamp", from_unix, deps=v.deps)
+        return None

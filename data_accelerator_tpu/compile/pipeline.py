@@ -39,16 +39,31 @@ class Pipeline:
     capacities: Dict[str, int]
     input_names: List[str]
     state_tables: List[str] = field(default_factory=list)
+    # dictionary-table registry for device string ops (stringops.py);
+    # the runtime materializes AuxTableBuilder(aux_registry, dictionary)
+    # .tables() per batch and passes it as tables["__aux"]
+    aux_registry: Optional[object] = None
 
     def run(
-        self, tables: Dict[str, TableData], base_s, now_rel_ms
+        self, tables: Dict[str, TableData], base_s, now_rel_ms, aux=None
     ) -> Dict[str, TableData]:
         """Execute all statements; returns every view (inputs included).
 
         Pure function of its inputs — safe to wrap in jax.jit (TableData
-        is a pytree).
+        is a pytree). ``aux``: the string-op dictionary tables
+        ({key: array}); required when the flow uses string functions
+        (``aux_registry`` non-empty).
         """
         env: Dict[str, TableData] = dict(tables)
+        if aux is not None:
+            env["__aux"] = aux
+        if "__aux" not in env:
+            if self.aux_registry is not None and not self.aux_registry.empty:
+                raise EngineException(
+                    "this pipeline uses string functions; pass aux= "
+                    "(AuxTableBuilder.tables()) to Pipeline.run"
+                )
+            env["__aux"] = {}
         for view in self.views:
             env[view.name] = view.fn(env, base_s, now_rel_ms)
         return env
@@ -94,10 +109,16 @@ class PipelineCompiler:
         dictionary: StringDictionary,
         udfs: Optional[dict] = None,
         config: PlannerConfig = PlannerConfig(),
+        aux: Optional[object] = None,
     ):
+        from .stringops import AuxRegistry
+
         self.dictionary = dictionary
         self.udfs = udfs or {}
         self.config = config
+        # one registry per flow: projections and every statement share
+        # dictionary tables for identical string expressions
+        self.aux = aux if aux is not None else AuxRegistry()
 
     def compile_transform(
         self,
@@ -136,7 +157,8 @@ class PipelineCompiler:
                 continue
             sel = parse_select(cmd.text)
             compiler = SelectCompiler(
-                catalog, capacities, self.dictionary, self.udfs, self.config
+                catalog, capacities, self.dictionary, self.udfs, self.config,
+                aux=self.aux,
             )
             view = compiler.compile_select(cmd.name, sel)
             views.append(view)
@@ -149,4 +171,5 @@ class PipelineCompiler:
             capacities=capacities,
             input_names=list(inputs) + state_names,
             state_tables=state_names,
+            aux_registry=self.aux,
         )

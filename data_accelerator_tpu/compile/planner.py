@@ -117,8 +117,8 @@ class _AggCollector(ExprCompiler):
     """ExprCompiler that records aggregate calls and compiles them into
     placeholder reads from the "__agg" scope."""
 
-    def __init__(self, scope, dictionary, udfs):
-        super().__init__(scope, dictionary, udfs)
+    def __init__(self, scope, dictionary, udfs, aux=None):
+        super().__init__(scope, dictionary, udfs, aux=aux)
         self.agg_nodes: Dict[str, Tuple[str, Optional[Expr], bool]] = {}
         # custom aggregates (UDAF tier): key -> (udf, [arg exprs])
         self.udaf_nodes: Dict[str, Tuple[object, Tuple[Expr, ...]]] = {}
@@ -137,7 +137,7 @@ class _AggCollector(ExprCompiler):
         if udaf is not None and getattr(udaf, "is_aggregate", False):
             key = f"agg{next(self._counter)}"
             self.udaf_nodes[key] = (udaf, tuple(e.args))
-            plain = ExprCompiler(self.scope, self.dictionary, self.udfs)
+            plain = ExprCompiler(self.scope, self.dictionary, self.udfs, aux=self.aux)
             arg_types = []
             for a in e.args:
                 inner = plain.compile(a)
@@ -157,7 +157,7 @@ class _AggCollector(ExprCompiler):
             return "long"
         if arg is None:
             raise EngineException(f"{name} requires an argument")
-        inner = ExprCompiler(self.scope, self.dictionary, self.udfs).compile(arg)
+        inner = ExprCompiler(self.scope, self.dictionary, self.udfs, aux=self.aux).compile(arg)
         if not is_device(inner):
             raise EngineException(f"cannot aggregate non-device expression {arg!r}")
         if name == "AVG":
@@ -212,18 +212,36 @@ class SelectCompiler:
         dictionary: StringDictionary,
         udfs: Optional[dict] = None,
         config: PlannerConfig = PlannerConfig(),
+        aux: Optional["AuxRegistry"] = None,
     ):
         self.catalog = catalog
         self.capacities = capacities
         self.dictionary = dictionary
         self.udfs = udfs or {}
         self.config = config
+        # shared dictionary-table registry (device string ops); the
+        # runtime materializes these tables per batch and passes them in
+        # under the "__aux" pseudo-table (compile/stringops.py)
+        from .stringops import AuxRegistry
+
+        self.aux = aux if aux is not None else AuxRegistry()
+
+    def _expr_compiler(self, scope: Scope) -> ExprCompiler:
+        return ExprCompiler(scope, self.dictionary, self.udfs, aux=self.aux)
 
     # -- entry -----------------------------------------------------------
     def compile_select(self, name: str, sel: Select) -> CompiledView:
         if sel.union is not None:
-            return self._compile_union(name, sel)
-        return self._compile_single(name, sel)
+            view = self._compile_union(name, sel)
+        else:
+            view = self._compile_single(name, sel)
+        return view
+
+    @staticmethod
+    def _inject_aux(scopes, tables) -> None:
+        """Expose the dictionary string-op tables to expressions (the
+        "__aux" pseudo-scope; see compile/stringops.py)."""
+        scopes["__aux"] = tables.get("__aux", {})
 
     # -- union -----------------------------------------------------------
     def _compile_union(self, name: str, sel: Select) -> CompiledView:
@@ -232,6 +250,10 @@ class SelectCompiler:
         while cur is not None:
             branches.append(replace(cur, union=None, union_distinct=False))
             cur = cur.union
+        # a trailing ORDER BY/LIMIT parses into the last branch but (per
+        # SQL) applies to the whole union — hoist it
+        order_by, limit = branches[-1].order_by, branches[-1].limit
+        branches[-1] = replace(branches[-1], order_by=(), limit=None)
         compiled = [self._compile_single(f"{name}${i}", b) for i, b in enumerate(branches)]
         first = compiled[0]
         names0 = list(first.schema.types) + list(first.schema.deferred)
@@ -259,7 +281,10 @@ class SelectCompiler:
             return TableData(cols, valid)
 
         schema = ViewSchema(dict(first.schema.types), dict(first.schema.deferred))
-        return CompiledView(name, schema, capacity, run)
+        view = CompiledView(name, schema, capacity, run)
+        if order_by or limit is not None:
+            view = self._apply_order_limit(view, order_by, limit)
+        return view
 
     # -- single select ---------------------------------------------------
     def _compile_single(self, name: str, sel: Select) -> CompiledView:
@@ -269,19 +294,19 @@ class SelectCompiler:
         # 1. FROM/JOIN scope
         scope, build_scope, scope_capacity = self._compile_from(sel)
 
-        compiler = _AggCollector(scope, self.dictionary, self.udfs)
+        compiler = _AggCollector(scope, self.dictionary, self.udfs, aux=self.aux)
 
         # 2. WHERE
         where_fn = None
         if sel.where is not None:
-            where_c = ExprCompiler(scope, self.dictionary, self.udfs).compile(sel.where)
+            where_c = self._expr_compiler(scope).compile(sel.where)
             if not is_device(where_c):
                 raise EngineException("WHERE must be device-computable")
             where_fn = where_c.fn
 
         grouped = bool(sel.group_by) or any(
             _has_aggregate(i.expr) for i in sel.items if not isinstance(i.expr, Star)
-        )
+        ) or (sel.having is not None and _has_aggregate(sel.having))
 
         # 3. select items -> named output values
         out_values: List[Tuple[str, Value]] = []
@@ -291,11 +316,26 @@ class SelectCompiler:
         out_types, deferred, flat_outputs = self._flatten_outputs(out_values)
 
         if grouped:
-            return self._compile_grouped(
+            # HAVING compiles with the SAME collector so its aggregates
+            # (possibly absent from the select list) compute per group
+            having_c = (
+                compiler.compile(sel.having) if sel.having is not None else None
+            )
+            if having_c is not None and not is_device(having_c):
+                raise EngineException("HAVING must be device-computable")
+            view = self._compile_grouped(
                 name, sel, scope, compiler, build_scope, scope_capacity,
                 where_fn, out_types, deferred, flat_outputs, out_values,
+                having_fn=having_c.fn if having_c is not None else None,
             )
+            if sel.order_by or sel.limit is not None:
+                view = self._apply_order_limit(view, sel.order_by, sel.limit)
+            return view
 
+        if sel.having is not None:
+            raise EngineException(
+                f"HAVING without aggregation in {name}; use WHERE"
+            )
         if compiler.udaf_nodes:
             names = ", ".join(u.name for u, _ in compiler.udaf_nodes.values())
             raise EngineException(
@@ -309,6 +349,7 @@ class SelectCompiler:
 
         def run(tables, base_s, now_rel_ms):
             scopes, valid, shape = build_scope(tables, base_s, now_rel_ms)
+            self._inject_aux(scopes, tables)
             env = EvalEnv(scopes, base_s, now_rel_ms, shape)
             if where_fn is not None:
                 valid = valid & where_fn(env)
@@ -320,7 +361,10 @@ class SelectCompiler:
             return TableData(cols, valid)
 
         schema = ViewSchema(out_types, deferred)
-        return CompiledView(name, schema, scope_capacity, run)
+        view = CompiledView(name, schema, scope_capacity, run)
+        if sel.order_by or sel.limit is not None:
+            view = self._apply_order_limit(view, sel.order_by, sel.limit)
+        return view
 
     # -- FROM / JOIN -----------------------------------------------------
     def _view(self, table: str) -> ViewSchema:
@@ -405,11 +449,15 @@ class SelectCompiler:
                 right = tables[rn]
                 shape_l = acc_valid.shape
                 shape_r = right.valid.shape
-                lscopes = {}
+                aux_tables = tables.get("__aux", {})
+                lscopes = {"__aux": aux_tables}
                 for (b, c), arr in acc_cols.items():
                     lscopes.setdefault(b, {})[c] = arr
                 lenv = EvalEnv(lscopes, base_s, now_rel_ms, shape_l)
-                renv = EvalEnv({rb: right.cols}, base_s, now_rel_ms, shape_r)
+                renv = EvalEnv(
+                    {rb: right.cols, "__aux": aux_tables},
+                    base_s, now_rel_ms, shape_r,
+                )
 
                 lkeys = [le.fn(lenv) for le, _ in eq_pairs]
                 rkeys = [re_.fn(renv) for _, re_ in eq_pairs]
@@ -417,12 +465,14 @@ class SelectCompiler:
                 res_fn = None
                 if residual is not None:
                     def res_fn(li, ri, residual=residual, lscopes=lscopes,
-                               right=right, rb=rb):
+                               right=right, rb=rb, aux_tables=aux_tables):
                         pl_scopes = {
                             b: {c: arr[li] for c, arr in cols.items()}
                             for b, cols in lscopes.items()
+                            if b != "__aux"
                         }
                         pl_scopes[rb] = {c: arr[ri] for c, arr in right.cols.items()}
+                        pl_scopes["__aux"] = aux_tables
                         env2 = EvalEnv(pl_scopes, base_s, now_rel_ms, li.shape)
                         return residual.fn(env2)
 
@@ -505,8 +555,8 @@ class SelectCompiler:
             )
         compiled_pairs = [
             (
-                ExprCompiler(lscope, self.dictionary, self.udfs).compile_device(le),
-                ExprCompiler(rscope, self.dictionary, self.udfs).compile_device(re_),
+                self._expr_compiler(lscope).compile_device(le),
+                self._expr_compiler(rscope).compile_device(re_),
             )
             for le, re_ in eq_pairs
         ]
@@ -518,7 +568,7 @@ class SelectCompiler:
             both = Scope(
                 tables={**lscope.tables, **rscope.tables},
             )
-            residual = ExprCompiler(both, self.dictionary, self.udfs).compile_device(expr)
+            residual = self._expr_compiler(both).compile_device(expr)
         return compiled_pairs, residual
 
     def _side_of(self, e: Expr, lscope: Scope, rscope: Scope) -> str:
@@ -698,10 +748,107 @@ class SelectCompiler:
             return [p for p in v.parts if isinstance(p, CompiledExpr)]
         return []
 
+    # -- ORDER BY / LIMIT ------------------------------------------------
+    def _apply_order_limit(self, view: CompiledView, order_by, limit) -> CompiledView:
+        """Wrap a view with device-side ordering and/or row limiting.
+
+        ORDER BY sorts valid rows to the front with a stable lexsort
+        (invalid rows last); string keys sort by dictionary rank, i.e.
+        true lexicographic order. LIMIT keeps the first N rows — with an
+        ORDER BY the output capacity shrinks to N, so downstream shapes
+        (and transfers) get smaller, the fixed-shape analog of Spark's
+        TakeOrdered. Keys resolve against the view's OUTPUT columns
+        (select aliases), the common top-N idiom.
+        """
+        from .stringops import RANK_KEY
+
+        visible = [
+            c for c in view.schema.types
+            if not c.startswith("__defer.") and not c.endswith(".__valid")
+        ]
+        out_scope = Scope(tables={"": {
+            c: view.schema.types[c] for c in visible
+        }})
+        compiler = self._expr_compiler(out_scope)
+        keys: List[Tuple[CompiledExpr, bool]] = []
+        from .sqlparser import Literal as _Lit
+
+        for item in order_by:
+            expr = item.expr
+            if isinstance(expr, _Lit) and expr.kind == "int":
+                # ORDER BY <ordinal>: 1-based select-list position
+                if not (1 <= expr.value <= len(visible)):
+                    raise EngineException(
+                        f"ORDER BY position {expr.value} is out of range "
+                        f"(select list has {len(visible)} device columns)"
+                    )
+                expr = Col((visible[expr.value - 1],))
+            ce = compiler.compile(expr)
+            if not is_device(ce):
+                raise EngineException(
+                    "ORDER BY key must be a device column/expression "
+                    f"(deferred strings cannot order): {item.expr!r}"
+                )
+            if ce.type == "string":
+                self.aux.require_rank()
+            keys.append((ce, item.ascending))
+
+        def run(tables, base_s, now_rel_ms):
+            t = view.fn(tables, base_s, now_rel_ms)
+            valid = t.valid
+            cols = t.cols
+            if keys:
+                scopes = {"": cols}
+                self._inject_aux(scopes, tables)
+                env = EvalEnv(scopes, base_s, now_rel_ms, valid.shape)
+                sort_keys = []
+                for ce, asc in keys:
+                    arr = ce.fn(env)
+                    if ce.type == "string":
+                        rank_t = scopes["__aux"][RANK_KEY]
+                        arr = rank_t[jnp.clip(arr, 0, rank_t.shape[0] - 1)]
+                    if arr.dtype == jnp.bool_:
+                        arr = arr.astype(jnp.int32)
+                    if not asc:
+                        arr = -arr
+                    sort_keys.append(arr)
+                # lexsort: LAST key is primary -> invalid rows sort last,
+                # then keys in reverse significance order (stable)
+                perm = jnp.lexsort(
+                    tuple(reversed(sort_keys))
+                    + (jnp.logical_not(valid).astype(jnp.int32),)
+                )
+                cols = {
+                    c: (a[perm] if a.shape[:1] == valid.shape else a)
+                    for c, a in cols.items()
+                }
+                valid = valid[perm]
+            if limit is not None:
+                if keys:
+                    # rows are sorted valid-first: a plain prefix mask
+                    keep = jnp.arange(valid.shape[0]) < limit
+                else:
+                    # unsorted: keep the first N valid rows in place
+                    keep = jnp.cumsum(valid.astype(jnp.int32)) <= limit
+                valid = valid & keep
+                if keys and limit < valid.shape[0]:
+                    cols = {
+                        c: (a[:limit] if a.shape[:1] == (valid.shape[0],) else a)
+                        for c, a in cols.items()
+                    }
+                    valid = valid[:limit]
+            return TableData(cols, valid)
+
+        capacity = view.capacity
+        if limit is not None and keys and limit < capacity:
+            capacity = limit
+        return CompiledView(view.name, view.schema, capacity, run)
+
     # -- grouped path ----------------------------------------------------
     def _compile_grouped(
         self, name, sel, scope, compiler, build_scope, scope_capacity,
         where_fn, out_types, deferred, flat_outputs, out_values,
+        having_fn=None,
     ) -> CompiledView:
         # group keys: resolve against select aliases first, then scope
         alias_map = {}
@@ -716,7 +863,7 @@ class SelectCompiler:
                 key_exprs.append(g)
 
         key_compiled: List[CompiledExpr] = []
-        plain = ExprCompiler(scope, self.dictionary, self.udfs)
+        plain = self._expr_compiler(scope)
         for g in key_exprs:
             v = plain.compile(g)
             if isinstance(v, HostStr):
@@ -734,6 +881,14 @@ class SelectCompiler:
             agg_args[key] = (
                 None if arg is None else plain.compile_device(arg, f"{fname} argument")
             )
+            if (
+                fname in ("MIN", "MAX")
+                and agg_args[key] is not None
+                and agg_args[key].type == "string"
+            ):
+                # string MIN/MAX aggregate in rank space (lexicographic),
+                # mapped back to ids via the inverse table
+                self.aux.require_rank()
         udaf_nodes = compiler.udaf_nodes
         udaf_args: Dict[str, List[CompiledExpr]] = {
             key: [
@@ -747,6 +902,8 @@ class SelectCompiler:
 
         def run(tables, base_s, now_rel_ms):
             scopes, valid, shape = build_scope(tables, base_s, now_rel_ms)
+            self._inject_aux(scopes, tables)
+            aux_tables = scopes["__aux"]
             env = EvalEnv(scopes, base_s, now_rel_ms, shape)
             if where_fn is not None:
                 valid = valid & where_fn(env)
@@ -786,6 +943,17 @@ class SelectCompiler:
                     agg_results[key] = s / jnp.maximum(c, 1).astype(jnp.float32)
                 elif fname in ("MIN", "MAX"):
                     op = fname.lower()
+                    is_string = agg_args[key].type == "string"
+                    live = valid_s
+                    if is_string:
+                        # lexicographic min/max: aggregate ranks, invert.
+                        # SQL MIN/MAX ignore NULLs, so null ids (0) are
+                        # masked out like invalid rows
+                        from .stringops import RANK_KEY, UNRANK_KEY
+
+                        live = live & (vals != 0)
+                        rank_t = aux_tables[RANK_KEY]
+                        vals = rank_t[jnp.clip(vals, 0, rank_t.shape[0] - 1)]
                     ident = (
                         jnp.iinfo(jnp.int32).max if vals.dtype in (jnp.int32,)
                         else jnp.asarray(jnp.inf, vals.dtype)
@@ -795,8 +963,15 @@ class SelectCompiler:
                             jnp.iinfo(jnp.int32).min if vals.dtype in (jnp.int32,)
                             else jnp.asarray(-jnp.inf, vals.dtype)
                         )
-                    z = jnp.where(valid_s, vals, jnp.full_like(vals, ident))
-                    agg_results[key] = segment_aggregate(z, seg, capacity, op, valid_s)
+                    z = jnp.where(live, vals, jnp.full_like(vals, ident))
+                    res = segment_aggregate(z, seg, capacity, op, live)
+                    if is_string:
+                        # group with no non-null value -> NULL (rank 0 is
+                        # always the null entry, so unrank[0] == id 0)
+                        unrank_t = aux_tables[UNRANK_KEY]
+                        res = jnp.where(res == ident, 0, res)
+                        res = unrank_t[jnp.clip(res, 0, unrank_t.shape[0] - 1)]
+                    agg_results[key] = res
             for key, (udf, _args) in udaf_nodes.items():
                 arg_arrays = [a.fn(env)[order] for a in udaf_args[key]]
                 agg_results[key] = udf.reduce(arg_arrays, seg, capacity, valid_s)
@@ -808,12 +983,16 @@ class SelectCompiler:
             rep_scopes = {
                 b: {c: arr[rep_idx] for c, arr in cols.items()}
                 for b, cols in scopes.items()
+                if b != "__aux"  # dictionary tables are not row-shaped
             }
             rep_scopes["__agg"] = agg_results
+            rep_scopes["__aux"] = aux_tables
             group_env = EvalEnv(rep_scopes, base_s, now_rel_ms, (capacity,))
 
             cols = {n: fn(group_env) for n, fn in flat_outputs}
             out_valid = jnp.arange(capacity) < num_groups
+            if having_fn is not None:
+                out_valid = out_valid & having_fn(group_env)
             # groups beyond the static capacity are dropped; ride the
             # drop count along as a hidden column so the runtime can
             # emit it as an overflow metric (Output_<n>_GroupsDropped)

@@ -94,7 +94,26 @@ class IsNull:
     negated: bool = False
 
 
-Expr = Union[Literal, Col, Star, Func, Cast, BinOp, UnaryOp, InList, CaseWhen, IsNull]
+@dataclass(frozen=True)
+class LikeOp:
+    """``expr [NOT] LIKE 'pattern'`` / ``expr RLIKE 'regex'``."""
+
+    expr: "Expr"
+    pattern: "Expr"  # must be a string literal at compile time
+    negated: bool = False
+    regex: bool = False  # RLIKE / REGEXP
+
+
+Expr = Union[
+    Literal, Col, Star, Func, Cast, BinOp, UnaryOp, InList, CaseWhen,
+    IsNull, LikeOp,
+]
+
+
+@dataclass(frozen=True)
+class OrderItem:
+    expr: "Expr"
+    ascending: bool = True
 
 
 @dataclass(frozen=True)
@@ -127,6 +146,9 @@ class Select:
     joins: Tuple[JoinClause, ...] = ()
     where: Optional[Expr] = None
     group_by: Tuple[Expr, ...] = ()
+    having: Optional[Expr] = None
+    order_by: Tuple[OrderItem, ...] = ()
+    limit: Optional[int] = None
     distinct: bool = False
     union: Optional["Select"] = None  # UNION ALL chain
     union_distinct: bool = False
@@ -153,6 +175,11 @@ KEYWORDS = {
     "OR", "NOT", "IN", "IS", "NULL", "TRUE", "FALSE", "UNION", "ALL",
     "CASE", "WHEN", "THEN", "ELSE", "END", "CAST", "LIKE", "BETWEEN",
 }
+
+# contextual keywords: recognized only in their clause position, so
+# columns/aliases named "desc", "having", "regexp" etc. keep parsing
+# (they are not reserved words in this dialect's existing surface)
+_CONTEXTUAL = ("HAVING", "ASC", "DESC", "RLIKE", "REGEXP")
 
 
 @dataclass
@@ -217,6 +244,14 @@ class _Parser:
             return True
         return False
 
+    def accept_ctx_kw(self, *words: str) -> Optional[str]:
+        """Accept a contextual keyword (plain ident matched by value)."""
+        t = self.peek()
+        if t.kind == "ident" and t.value.upper() in words:
+            self.next()
+            return t.value.upper()
+        return None
+
     def expect_op(self, op: str) -> None:
         if not self.accept_op(op):
             raise SqlParseError(f"expected {op!r}, got {self.peek().value!r} in: {self.text[:200]}")
@@ -262,11 +297,34 @@ class _Parser:
             while self.accept_op(","):
                 group_by.append(self.parse_expr())
 
+        having = None
+        if self.accept_ctx_kw("HAVING"):
+            having = self.parse_expr()
+
         union = None
         union_distinct = False
         if self.accept_kw("UNION"):
             union_distinct = not self.accept_kw("ALL")
             union = self.parse_select()
+
+        # trailing ORDER BY / LIMIT (after a UNION chain they apply to
+        # the whole union, which the planner honors by hoisting)
+        order_by: List[OrderItem] = []
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            while True:
+                e = self.parse_expr()
+                asc = self.accept_ctx_kw("ASC", "DESC") != "DESC"
+                order_by.append(OrderItem(e, asc))
+                if not self.accept_op(","):
+                    break
+
+        limit = None
+        if self.accept_kw("LIMIT"):
+            t = self.next()
+            if t.kind != "num" or "." in t.value:
+                raise SqlParseError(f"LIMIT expects an integer, got {t.value!r}")
+            limit = int(t.value)
 
         return Select(
             items=tuple(items),
@@ -274,6 +332,9 @@ class _Parser:
             joins=tuple(joins),
             where=where,
             group_by=tuple(group_by),
+            having=having,
+            order_by=tuple(order_by),
+            limit=limit,
             distinct=distinct,
             union=union,
             union_distinct=union_distinct,
@@ -287,7 +348,12 @@ class _Parser:
         alias = None
         if self.accept_kw("AS"):
             alias = self.next().value
-        elif self.peek().kind == "ident":
+        elif (
+            self.peek().kind == "ident"
+            and self.peek().value.upper() not in _CONTEXTUAL
+        ):
+            # bare alias — but not a clause word in clause position
+            # (FROM t HAVING ... / ORDER BY x DESC must not eat it)
             alias = self.next().value
         return TableRef(name, alias)
 
@@ -343,9 +409,16 @@ class _Parser:
             op = "!=" if t.value == "<>" else t.value
             return BinOp(op, left, self.parse_additive())
         negated = False
-        if self.peek().kind == "kw" and self.peek().value == "NOT" and self.peek(1).value == "IN":
+        if (
+            self.peek().kind == "kw" and self.peek().value == "NOT"
+            and self.peek(1).value.upper() in ("IN", "LIKE", "RLIKE", "REGEXP")
+        ):
             self.next()
             negated = True
+        if self.accept_kw("LIKE"):
+            return LikeOp(left, self.parse_additive(), negated, regex=False)
+        if self.accept_ctx_kw("RLIKE", "REGEXP"):
+            return LikeOp(left, self.parse_additive(), negated, regex=True)
         if self.accept_kw("IN"):
             self.expect_op("(")
             options = [self.parse_expr()]

@@ -70,12 +70,14 @@ def step_shardings(mesh: Mesh):
     """(in_shardings, out_shardings) pytree prefixes for
     ``FlowProcessor``'s step signature:
 
-    in:  (raw, ring, state, refdata, base_s, now_rel_ms, slot, delta_ms)
+    in:  (raw, ring, state, refdata, base_s, now_rel_ms, slot, delta_ms,
+          aux string-op dictionary tables — replicated: every chip gathers
+          locally, like a broadcast join side)
     out: (datasets, new_ring, new_state, counts_vec)
     """
     row = row_sharding(mesh)
     ring = ring_sharding(mesh)
     rep = replicated(mesh)
-    in_shardings = (row, ring, rep, rep, rep, rep, rep, rep)
+    in_shardings = (row, ring, rep, rep, rep, rep, rep, rep, rep)
     out_shardings = (rep, ring, rep, rep)
     return in_shardings, out_shardings

@@ -244,6 +244,10 @@ class FlowProcessor:
     def _build_pipeline(self, output_datasets: Optional[List[str]]):
         cap = self.batch_capacity
         pc = PipelineCompiler(self.dictionary, self.udfs)
+        # one dictionary-table registry for the whole flow (projection +
+        # transform share string-op tables; see compile/stringops.py);
+        # the builder materializes them per batch for the jitted step
+        self.aux_registry = pc.aux
 
         # 1. projection pipeline: Raw -> DataXProcessedInput
         from ..compile.planner import SelectCompiler
@@ -255,7 +259,8 @@ class FlowProcessor:
         for i, step_text in enumerate(self.projection_steps):
             sel = self._projection_select(step_text, cur_name)
             compiler = SelectCompiler(
-                proj_catalog, proj_caps, self.dictionary, self.udfs
+                proj_catalog, proj_caps, self.dictionary, self.udfs,
+                aux=pc.aux,
             )
             vname = (
                 DatasetName.DataStreamProjection
@@ -290,6 +295,9 @@ class FlowProcessor:
         self.pipeline: Pipeline = pc.compile_transform(
             self.transform_text, inputs, state_inputs
         )
+        from ..compile.stringops import AuxTableBuilder
+
+        self.aux_tables = AuxTableBuilder(self.aux_registry, self.dictionary)
 
         # output datasets: explicit list or conf-declared output names that
         # match pipeline views (S500-style dataset==output-name contract)
@@ -339,10 +347,12 @@ class FlowProcessor:
             now_rel_ms: jnp.ndarray,
             slot: jnp.ndarray,
             delta_ms: jnp.ndarray,
+            aux: Dict[str, jnp.ndarray],
         ):
             env: Dict[str, TableData] = {
                 "Raw": raw,
                 DatasetName.DataStreamRaw: raw,
+                "__aux": aux,
             }
             for v in proj_views:
                 env[v.name] = v.fn(env, base_s, now_rel_ms)
@@ -364,7 +374,7 @@ class FlowProcessor:
             for sname in state_names:
                 tables[sname] = state[sname]
 
-            out = pipeline.run(tables, base_s, now_rel_ms)
+            out = pipeline.run(tables, base_s, now_rel_ms, aux=aux)
 
             new_state = {n: out.get(n, state[n]) for n in state_names}
 
@@ -551,9 +561,14 @@ class FlowProcessor:
 
         ring = self.window_buffers.get("__ring")
         refdata_tables = {n: t for n, (_, t) in self.refdata.items()}
+        # string-op dictionary tables: refreshed AFTER this batch's encode
+        # (so they cover every id the batch can contain), cached until the
+        # dictionary grows; growth past table capacity retraces the step
+        aux = self.aux_tables.tables()
         out_datasets, new_ring, new_state, counts_vec = self._step(
             raw, ring, self.state_data, refdata_tables,
             base_s, now_rel_ms, slot, jnp.asarray(delta_ms, jnp.int32),
+            aux,
         )
         # carry device state forward without materializing — the next
         # dispatch may consume these handles before this batch collects
