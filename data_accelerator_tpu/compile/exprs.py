@@ -831,12 +831,15 @@ class ExprCompiler:
                 lambda s, sub=sub: spark_instr(s, sub),
             )
         if name == "LOCATE":
-            # LOCATE(substr, str[, pos]) — note the flipped arg order
+            # LOCATE(substr, str[, pos]) — note the flipped arg order.
+            # Spark returns 0 (not a 1-based hit) whenever pos < 1.
             sub = self._const_str(args[0], "LOCATE substring")
             start = self._const_int(args[2], "LOCATE pos") if len(args) > 2 else 1
             return self._string_scalar(
                 name, args[1], f"LOCATE:{sub!r}:{start}",
-                lambda s, sub=sub, p=start: s.find(sub, max(0, p - 1)) + 1,
+                lambda s, sub=sub, p=start: (
+                    0 if p < 1 else s.find(sub, p - 1) + 1
+                ),
             )
         if name == "CONTAINS":
             sub = self._const_str(args[1], "CONTAINS substring")
@@ -877,8 +880,63 @@ class ExprCompiler:
             pat = self._const_str(args[1], "REGEXP_REPLACE pattern")
             repl = self._const_str(args[2], "REGEXP_REPLACE replacement")
             rx = re.compile(pat)
-            # Spark uses Java's $1 group refs; Python uses \1
-            py_repl = re.sub(r"\$(\d+)", r"\\\1", repl)
+            # Spark uses Java's $N group refs; Python uses \g<N>. A Java
+            # \$ escape means a literal dollar — protect it before the
+            # group rewrite, and escape Python's own backslash refs.
+            # Java binds the LONGEST digit run that is still a valid
+            # group number ($10 with one group = group 1 + literal '0')
+            # and errors when even the first digit names no group.
+            def _java_repl_to_py(r: str, ngroups: int) -> str:
+                out = []
+                i = 0
+                while i < len(r):
+                    c = r[i]
+                    if c == "\\":
+                        if i + 1 >= len(r):
+                            raise EngineException(
+                                "REGEXP_REPLACE replacement ends with a "
+                                "lone backslash (character to be escaped "
+                                "is missing)"
+                            )
+                        nxt = r[i + 1]
+                        # Java-escaped literal ($, \) — emit literally,
+                        # re-escaping \ for Python's repl grammar
+                        out.append("\\\\" if nxt == "\\" else nxt)
+                        i += 2
+                        continue
+                    # Java's replacement grammar treats only ASCII 0-9
+                    # as group digits (str.isdigit would admit Unicode
+                    # digits and crash or mis-bind)
+                    ascii_digit = lambda ch: "0" <= ch <= "9"
+                    if c == "$":
+                        if i + 1 >= len(r) or not ascii_digit(r[i + 1]):
+                            raise EngineException(
+                                "REGEXP_REPLACE replacement has an "
+                                "illegal group reference: '$' must be "
+                                "followed by a group number (escape a "
+                                "literal dollar as \\$)"
+                            )
+                        j = i + 1
+                        while (
+                            j + 1 < len(r) and ascii_digit(r[j + 1])
+                            and int(r[i + 1:j + 2]) <= ngroups
+                        ):
+                            j += 1
+                        group = int(r[i + 1:j + 1])
+                        if group > ngroups:
+                            raise EngineException(
+                                f"REGEXP_REPLACE replacement refers to "
+                                f"group ${group} but the pattern has only "
+                                f"{ngroups} group(s)"
+                            )
+                        out.append(f"\\g<{group}>")
+                        i = j + 1
+                        continue
+                    out.append("\\\\" if c == "\\" else c)
+                    i += 1
+                return "".join(out)
+
+            py_repl = _java_repl_to_py(repl, rx.groups)
             return self._string_map(
                 name, args[0], f"REGEXP_REPLACE:{pat!r}:{repl!r}",
                 lambda s, rx=rx, r=py_repl: rx.sub(r, s),

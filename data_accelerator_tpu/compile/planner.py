@@ -101,6 +101,46 @@ class TableData:
         return cls(dict(zip(names, children[:-1])), children[-1])
 
 
+# ORDER BY two-tier resolution bindings (see _OrderKeyScope)
+_OUT_BINDING = "__ob.out"
+_SRC_BINDING_PREFIX = "__ob.src:"
+
+
+class _OrderKeyScope(Scope):
+    """Per-REFERENCE two-tier resolution for ORDER BY keys (Spark
+    semantics): each column ref binds to an output alias first, then to
+    a FROM-scope column. Resolving the whole expression against one
+    scope or the other would rebind aliases that shadow source columns
+    in mixed expressions like ``ORDER BY a + b`` with ``SELECT b AS a``.
+    """
+
+    def __init__(self, out_scope: Scope, src_scope: Scope):
+        tables = {_OUT_BINDING: dict(out_scope.tables[""])}
+        deferred = {}
+        for b, cols in src_scope.tables.items():
+            tables[_SRC_BINDING_PREFIX + b] = cols
+        for b, d in src_scope.deferred.items():
+            deferred[_SRC_BINDING_PREFIX + b] = d
+        super().__init__(tables=tables, deferred=deferred)
+        self._out = out_scope
+        self._src = src_scope
+
+    def resolve(self, parts):
+        try:
+            _, col = self._out.resolve(parts)
+            return (_OUT_BINDING, col)
+        except EngineException as out_err:
+            try:
+                b, col = self._src.resolve(parts)
+            except EngineException:
+                raise EngineException(
+                    f"cannot resolve ORDER BY reference "
+                    f"'{'.'.join(parts)}' against the select list or the "
+                    f"FROM scope: {out_err}"
+                ) from None
+            return (_SRC_BINDING_PREFIX + b, col)
+
+
 @dataclass
 class CompiledView:
     name: str
@@ -108,6 +148,9 @@ class CompiledView:
     capacity: int
     # fn(tables: {name: TableData}, base_s, now_rel_ms) -> TableData
     fn: Callable[[Dict[str, TableData], jnp.ndarray, jnp.ndarray], TableData]
+    # select list in declaration order, for ORDER BY <ordinal> binding
+    # (None for views not built from a select list, e.g. inputs)
+    select_values: Optional[List[Tuple[str, Value]]] = None
 
 
 # ---------------------------------------------------------------------------
@@ -281,7 +324,10 @@ class SelectCompiler:
             return TableData(cols, valid)
 
         schema = ViewSchema(dict(first.schema.types), dict(first.schema.deferred))
-        view = CompiledView(name, schema, capacity, run)
+        view = CompiledView(
+            name, schema, capacity, run,
+            select_values=compiled[0].select_values,
+        )
         if order_by or limit is not None:
             view = self._apply_order_limit(view, order_by, limit)
         return view
@@ -328,7 +374,11 @@ class SelectCompiler:
                 where_fn, out_types, deferred, flat_outputs, out_values,
                 having_fn=having_c.fn if having_c is not None else None,
             )
+            view.select_values = out_values
             if sel.order_by or sel.limit is not None:
+                # grouped: output rows are groups, not source rows, so
+                # keys resolve against the output scope only (as Spark
+                # requires grouping/aggregate expressions here)
                 view = self._apply_order_limit(view, sel.order_by, sel.limit)
             return view
 
@@ -361,9 +411,18 @@ class SelectCompiler:
             return TableData(cols, valid)
 
         schema = ViewSchema(out_types, deferred)
-        view = CompiledView(name, schema, scope_capacity, run)
+        view = CompiledView(
+            name, schema, scope_capacity, run, select_values=out_values
+        )
         if sel.order_by or sel.limit is not None:
-            view = self._apply_order_limit(view, sel.order_by, sel.limit)
+            # Spark rejects DISTINCT + ORDER BY on unselected columns
+            # (the sort key would come from an arbitrary representative
+            # row), so the source-scope fallback is withheld there
+            view = self._apply_order_limit(
+                view, sel.order_by, sel.limit,
+                src_scope=None if sel.distinct else scope,
+                src_build=None if sel.distinct else build_scope,
+            )
         return view
 
     # -- FROM / JOIN -----------------------------------------------------
@@ -749,7 +808,29 @@ class SelectCompiler:
         return []
 
     # -- ORDER BY / LIMIT ------------------------------------------------
-    def _apply_order_limit(self, view: CompiledView, order_by, limit) -> CompiledView:
+    @staticmethod
+    def _col_refs(expr) -> List[str]:
+        """Dotted names of every column reference inside an expression."""
+        refs: List[str] = []
+
+        def walk(node):
+            if isinstance(node, Col):
+                refs.append(".".join(node.parts))
+                return
+            if hasattr(node, "__dataclass_fields__"):
+                for f in node.__dataclass_fields__:
+                    walk(getattr(node, f))
+            elif isinstance(node, (tuple, list)):
+                for el in node:
+                    walk(el)
+
+        walk(expr)
+        return refs
+
+    def _apply_order_limit(
+        self, view: CompiledView, order_by, limit,
+        *, src_scope=None, src_build=None,
+    ) -> CompiledView:
         """Wrap a view with device-side ordering and/or row limiting.
 
         ORDER BY sorts valid rows to the front with a stable lexsort
@@ -757,8 +838,14 @@ class SelectCompiler:
         true lexicographic order. LIMIT keeps the first N rows — with an
         ORDER BY the output capacity shrinks to N, so downstream shapes
         (and transfers) get smaller, the fixed-shape analog of Spark's
-        TakeOrdered. Keys resolve against the view's OUTPUT columns
-        (select aliases), the common top-N idiom.
+        TakeOrdered.
+
+        Keys resolve against the view's OUTPUT columns (select aliases)
+        first, then — Spark semantics — against the FROM-scope columns
+        when the caller supplies one (``src_scope``/``src_build``; only
+        sound for ungrouped selects, where output row i is scope row i).
+        ``view.select_values`` (the select list in declaration order)
+        binds ``ORDER BY <ordinal>`` including deferred-string items.
         """
         from .stringops import RANK_KEY
 
@@ -769,20 +856,62 @@ class SelectCompiler:
         out_scope = Scope(tables={"": {
             c: view.schema.types[c] for c in visible
         }})
-        compiler = self._expr_compiler(out_scope)
+        if src_scope is not None:
+            key_scope: Scope = _OrderKeyScope(out_scope, src_scope)
+        else:
+            key_scope = out_scope
+        compiler = self._expr_compiler(key_scope)
+        select_values = view.select_values
+        # keys: (CompiledExpr, ascending)
         keys: List[Tuple[CompiledExpr, bool]] = []
         from .sqlparser import Literal as _Lit
 
         for item in order_by:
             expr = item.expr
             if isinstance(expr, _Lit) and expr.kind == "int":
-                # ORDER BY <ordinal>: 1-based select-list position
-                if not (1 <= expr.value <= len(visible)):
-                    raise EngineException(
-                        f"ORDER BY position {expr.value} is out of range "
-                        f"(select list has {len(visible)} device columns)"
-                    )
-                expr = Col((visible[expr.value - 1],))
+                # ORDER BY <ordinal>: 1-based select-list position,
+                # counted over the FULL select list (deferred strings
+                # and structs included), not just device columns
+                if select_values is not None:
+                    if not (1 <= expr.value <= len(select_values)):
+                        raise EngineException(
+                            f"ORDER BY position {expr.value} is out of range "
+                            f"(select list has {len(select_values)} items)"
+                        )
+                    sel_name, sel_val = select_values[expr.value - 1]
+                    if isinstance(sel_val, HostStr):
+                        raise EngineException(
+                            f"ORDER BY position {expr.value} refers to a "
+                            f"deferred string expression ('{sel_name}'); "
+                            "computed strings cannot be ordering keys"
+                        )
+                    if isinstance(sel_val, (StructValue, ArrayValue)):
+                        raise EngineException(
+                            f"ORDER BY position {expr.value} refers to "
+                            f"composite column '{sel_name}'; order by a "
+                            "scalar field instead"
+                        )
+                    expr = Col((sel_name,))
+                else:
+                    if not (1 <= expr.value <= len(visible)):
+                        raise EngineException(
+                            f"ORDER BY position {expr.value} is out of range "
+                            f"(select list has {len(visible)} device columns)"
+                        )
+                    expr = Col((visible[expr.value - 1],))
+            # any column ref naming a deferred-string output item must
+            # error (not silently fall through to a same-named source
+            # column the alias shadows) — also inside larger expressions
+            shadowed = [
+                r for r in self._col_refs(expr)
+                if r in view.schema.deferred
+            ]
+            if shadowed:
+                raise EngineException(
+                    f"ORDER BY key references deferred string "
+                    f"expression(s) {shadowed}; computed strings cannot "
+                    "be ordering keys"
+                )
             ce = compiler.compile(expr)
             if not is_device(ce):
                 raise EngineException(
@@ -793,12 +922,26 @@ class SelectCompiler:
                 self.aux.require_rank()
             keys.append((ce, item.ascending))
 
+        # does any key read a FROM-scope column the output lacks?
+        need_src = any(
+            b.startswith(_SRC_BINDING_PREFIX)
+            for ce, _ in keys for b, _c in ce.deps
+        )
+
         def run(tables, base_s, now_rel_ms):
             t = view.fn(tables, base_s, now_rel_ms)
             valid = t.valid
             cols = t.cols
             if keys:
-                scopes = {"": cols}
+                # output columns are visible under both the plain ""
+                # binding and the _OUT binding the two-tier scope emits
+                scopes = {"": cols, _OUT_BINDING: cols}
+                if need_src:
+                    # re-derive the FROM scope; XLA CSEs the duplicate
+                    # subgraph with the projection's own evaluation
+                    scopes_s, _, _shape_s = src_build(tables, base_s, now_rel_ms)
+                    for b, sc_cols in scopes_s.items():
+                        scopes[_SRC_BINDING_PREFIX + b] = sc_cols
                 self._inject_aux(scopes, tables)
                 env = EvalEnv(scopes, base_s, now_rel_ms, valid.shape)
                 sort_keys = []
@@ -842,7 +985,10 @@ class SelectCompiler:
         capacity = view.capacity
         if limit is not None and keys and limit < capacity:
             capacity = limit
-        return CompiledView(view.name, view.schema, capacity, run)
+        return CompiledView(
+            view.name, view.schema, capacity, run,
+            select_values=view.select_values,
+        )
 
     # -- grouped path ----------------------------------------------------
     def _compile_grouped(

@@ -50,10 +50,13 @@ _KIND_DTYPE = {
 RANK_KEY = "__rank"
 UNRANK_KEY = "__unrank"
 
-# bound on image-cascade rounds when building map tables: functions whose
-# results are new strings (which then need their own mapping, e.g.
-# REPLACE(REPLACE(x))) converge within a couple of rounds for real
-# flows; pathological self-growing chains stop here with a warning
+# default bound on image-cascade rounds when building map tables:
+# functions whose results are new strings (which then need their own
+# mapping, e.g. REPLACE(REPLACE(x))) converge within a couple of rounds
+# for real flows; pathological self-growing chains stop at the bound,
+# which is configurable per flow (``process.stringmap.maxrounds``) along
+# with a strict mode (``process.stringmap.strict``) that fails loud
+# instead of leaving unconverged entries NULL
 _MAX_ROUNDS = 4
 
 
@@ -106,14 +109,22 @@ class AuxTableBuilder:
     whenever the dictionary grew (ranks are global).
     """
 
-    def __init__(self, registry: AuxRegistry, dictionary: StringDictionary):
+    def __init__(
+        self,
+        registry: AuxRegistry,
+        dictionary: StringDictionary,
+        *,
+        max_rounds: int = _MAX_ROUNDS,
+        strict: bool = False,
+    ):
         self.registry = registry
         self.dictionary = dictionary
+        self.max_rounds = max_rounds
+        self.strict = strict
         self._np: Dict[str, np.ndarray] = {}
         self._filled = 0          # entries computed per incremental table
         self._built_len = -1      # dictionary length at last build
         self._device: Optional[Dict[str, object]] = None
-        self._warned_rounds = False
 
     # -- host-side table maintenance --------------------------------------
     def _extend_incremental(self) -> None:
@@ -127,7 +138,7 @@ class AuxTableBuilder:
         d = self.dictionary
         specs = [s for s in self.registry.specs.values()]
         rounds = 0
-        while self._filled < len(d) and rounds < _MAX_ROUNDS:
+        while self._filled < len(d) and rounds < self.max_rounds:
             rounds += 1
             start, end = self._filled, len(d)
             # decode once per new id, apply every spec
@@ -160,13 +171,27 @@ class AuxTableBuilder:
                     self._np[spec.key] = grown
                 self._np[spec.key][start:end] = vals
             self._filled = end
-        if self._filled < len(self.dictionary) and not self._warned_rounds:
-            self._warned_rounds = True
+        if self._filled < len(self.dictionary):
+            # every batch that leaves entries unmapped is reported (the
+            # set of affected strings changes batch to batch), with a
+            # sample of the strings that will evaluate to NULL
+            sample = [
+                repr(self.dictionary.decode(i))
+                for i in range(self._filled, min(self._filled + 5, len(d)))
+            ]
+            msg = (
+                f"string-map cascade did not converge in {self.max_rounds} "
+                f"rounds ({self._filled} of {len(self.dictionary)} "
+                f"dictionary entries mapped); unconverged entries evaluate "
+                f"to NULL, e.g. {', '.join(sample)} — raise "
+                f"datax.job.process.stringmap.maxrounds"
+            )
+            if self.strict:
+                from ..core.config import EngineException
+                raise EngineException(msg)
             logger.warning(
-                "string-map cascade did not converge in %d rounds "
-                "(%d of %d dictionary entries mapped); deeply nested "
-                "growing string functions may be approximate",
-                _MAX_ROUNDS, self._filled, len(self.dictionary),
+                "%s, or set datax.job.process.stringmap.strict=true to "
+                "fail loud", msg
             )
 
     def _build_rank(self, capacity: int) -> None:

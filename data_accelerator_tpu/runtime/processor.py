@@ -31,7 +31,7 @@ from ..compile.planner import PlannerConfig, TableData, ViewSchema
 from ..compile.sqlparser import parse_select
 from ..compile.transform_parser import TransformParser
 from ..constants import ColumnName, DatasetName
-from ..core.config import SettingDictionary, SettingNamespace
+from ..core.config import EngineException, SettingDictionary, SettingNamespace
 from ..core.schema import ColType, Schema, StringDictionary
 from .materialize import materialize_rows
 from .statetable import StateTable
@@ -297,7 +297,30 @@ class FlowProcessor:
         )
         from ..compile.stringops import AuxTableBuilder
 
-        self.aux_tables = AuxTableBuilder(self.aux_registry, self.dictionary)
+        from ..compile.stringops import _MAX_ROUNDS
+
+        try:
+            max_rounds = self.dict.get_int_option(
+                "datax.job.process.stringmap.maxrounds")
+        except ValueError as e:
+            raise EngineException(
+                f"datax.job.process.stringmap.maxrounds must be an "
+                f"integer: {e}"
+            ) from None
+        if max_rounds is None:
+            max_rounds = _MAX_ROUNDS
+        elif max_rounds < 1:
+            raise EngineException(
+                "datax.job.process.stringmap.maxrounds must be >= 1, got "
+                f"{max_rounds}"
+            )
+        self.aux_tables = AuxTableBuilder(
+            self.aux_registry, self.dictionary,
+            max_rounds=max_rounds,
+            strict=(self.dict.get_or_else(
+                "datax.job.process.stringmap.strict", "false") or ""
+            ).lower() == "true",
+        )
 
         # output datasets: explicit list or conf-declared output names that
         # match pipeline views (S500-style dataset==output-name contract)

@@ -478,3 +478,205 @@ def test_clause_words_stay_valid_identifiers():
         "SELECT desc AS d FROM T ORDER BY desc DESC LIMIT 1", cols, types
     )
     assert rows == [{"d": "b"}]
+
+
+# ---------------------------------------------------------------------------
+# ORDER BY resolution: Spark semantics (output aliases, then input columns)
+# ---------------------------------------------------------------------------
+def test_order_by_unselected_source_column():
+    """Spark allows ORDER BY on a column that was never selected."""
+    cols = {"name": ["x", "y", "z"], "score": [2, 9, 5]}
+    types = {"name": "string", "score": "long"}
+    rows, _, _ = run_select(
+        "SELECT name FROM T ORDER BY score DESC", cols, types
+    )
+    assert [r["name"] for r in rows] == ["y", "z", "x"]
+
+
+def test_order_by_source_expression_after_alias():
+    """ORDER BY over an expression of source columns aliased away."""
+    cols = {"a": [1, 2, 3], "b": [30, 10, 20]}
+    types = {"a": "long", "b": "long"}
+    rows, _, _ = run_select(
+        "SELECT a AS x FROM T ORDER BY a + b", cols, types
+    )
+    assert [r["x"] for r in rows] == [2, 3, 1]
+
+
+def test_order_by_prefers_output_alias_over_source():
+    """An alias that shadows a source column binds to the output column."""
+    cols = {"a": [1, 2, 3], "b": [30, 10, 20]}
+    types = {"a": "long", "b": "long"}
+    # 'a' in ORDER BY is the alias for b (output scope wins)
+    rows, _, _ = run_select(
+        "SELECT b AS a FROM T ORDER BY a", cols, types
+    )
+    assert [r["a"] for r in rows] == [10, 20, 30]
+
+
+def test_order_by_ordinal_counts_deferred_items():
+    """ORDER BY <ordinal> counts ALL select items; a deferred-string
+    target raises instead of silently binding the next device column."""
+    cols = {"a": [3, 1, 2], "b": ["p", "q", "r"]}
+    types = {"a": "long", "b": "string"}
+    with pytest.raises(EngineException, match="deferred string"):
+        run_select(
+            "SELECT CONCAT(b, '!') AS c, a FROM T ORDER BY 1", cols, types
+        )
+    # ordinal 2 is the device column a
+    rows, _, _ = run_select(
+        "SELECT CONCAT(b, '!') AS c, a FROM T ORDER BY 2", cols, types
+    )
+    assert [r["a"] for r in rows] == [1, 2, 3]
+
+
+def test_locate_pos_below_one_returns_zero():
+    """Spark: LOCATE(sub, str, pos) with pos < 1 is 0, not a hit."""
+    assert one_col("LOCATE('a', s, 0)")[3] == 0
+    assert one_col("LOCATE('a', s, -5)")[3] == 0
+    assert one_col("LOCATE('a', s, 1)")[3] == 2
+
+
+def test_regexp_replace_literal_dollar_escape():
+    """Java-escaped \\$ in the replacement is a literal dollar, and
+    $N group refs still substitute."""
+    got = one_col(r"REGEXP_REPLACE(s, '(o)', '\$[$1]')")
+    assert got[1] == "b$[o]b"
+
+
+def test_stringmap_cascade_strict_and_rounds(caplog):
+    """Unconverged cascades warn per batch with sample keys; strict
+    mode raises an EngineException instead."""
+    import logging
+
+    from data_accelerator_tpu.compile.stringops import AuxTableBuilder
+    from data_accelerator_tpu.compile.planner import SelectCompiler
+
+    def build(sql, max_rounds, strict):
+        dd = StringDictionary()
+        enc = jnp.asarray([dd.encode("abc")], jnp.int32)
+        t = TableData({"s": enc}, jnp.ones(1, jnp.bool_))
+        sc = SelectCompiler(
+            {"T": ViewSchema({"s": "string"})}, {"T": 1}, dd
+        )
+        view = sc.compile_select("V", parse_select(sql))
+        builder = AuxTableBuilder(
+            sc.aux, dd, max_rounds=max_rounds, strict=strict
+        )
+        return builder, view, t, dd
+
+    # 4 nested result-growing maps need >2 rounds to cover the deepest
+    # composed results
+    deep = ("SELECT UPPER(REPLACE(LPAD(REVERSE(s), 6, 'x'), 'x', 'yz')) "
+            "AS r FROM T")
+    builder, view, t, dd = build(deep, max_rounds=1, strict=False)
+    with caplog.at_level(logging.WARNING,
+                         logger="data_accelerator_tpu.compile.stringops"):
+        builder.tables()
+    assert any("did not converge" in r.message for r in caplog.records)
+
+    builder, view, t, dd = build(deep, max_rounds=1, strict=True)
+    with pytest.raises(EngineException, match="did not converge"):
+        builder.tables()
+
+    # a generous bound converges and evaluates the nest correctly
+    builder, view, t, dd = build(deep, max_rounds=8, strict=True)
+    aux = builder.tables()
+    out = view.fn(
+        {"T": t, "__aux": aux},
+        jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+    )
+    rid = int(np.asarray(out.cols["r"])[0])
+    assert dd.decode(rid) == "YZYZYZCBA"
+
+
+def test_order_by_deferred_alias_shadowing_source_column_errors():
+    """An alias bound to a deferred string expression must not fall
+    back to a same-named source column it shadows."""
+    cols = {"b": ["a", "b"], "c": ["2", "1"], "n": [10, 20]}
+    types = {"b": "string", "c": "string", "n": "long"}
+    with pytest.raises(EngineException, match="deferred"):
+        run_select(
+            "SELECT CONCAT(c, b) AS b, n FROM T ORDER BY b", cols, types
+        )
+
+
+def test_order_by_unresolvable_key_mentions_both_scopes():
+    cols = {"a": [1, 2]}
+    types = {"a": "long"}
+    with pytest.raises(EngineException, match="FROM scope"):
+        run_select("SELECT a FROM T ORDER BY nosuch", cols, types)
+
+
+def test_union_order_by_ordinal_counts_deferred_items():
+    cols = {"a": [3, 1], "b": ["p", "q"]}
+    types = {"a": "long", "b": "string"}
+    rows, _, _ = run_select(
+        "SELECT CONCAT(b, '!') AS c, a FROM T WHERE a > 1 "
+        "UNION ALL SELECT CONCAT(b, '?') AS c, a FROM T WHERE a <= 1 "
+        "ORDER BY 2",
+        cols, types,
+    )
+    assert [r["a"] for r in rows] == [1, 3]
+
+
+def test_regexp_replace_group_zero_and_digit_binding():
+    """$0 is the whole match; $10 with one group binds group 1 then a
+    literal '0' (Java's longest-valid-group rule); a flatly invalid
+    group ref fails at compile."""
+    got = one_col("REGEXP_REPLACE(s, '(o)', '[$0]')")
+    assert got[1] == "b[o]b"
+    got = one_col("REGEXP_REPLACE(s, '(o)', '$10')")
+    assert got[1] == "bo0b"
+    with pytest.raises(EngineException, match="only 1 group"):
+        one_col("REGEXP_REPLACE(s, '(o)', '$2')")
+
+
+def test_order_by_expression_over_deferred_alias_errors():
+    """A deferred alias inside a larger ORDER BY expression must error,
+    not silently bind the shadowed source column."""
+    cols = {"b": ["a", "b"], "c": ["2", "1"], "n": [10, 20]}
+    types = {"b": "string", "c": "string", "n": "long"}
+    with pytest.raises(EngineException, match="deferred"):
+        run_select(
+            "SELECT CONCAT(c, b) AS b, n FROM T ORDER BY LENGTH(b)",
+            cols, types,
+        )
+
+
+def test_regexp_replace_illegal_refs_fail_compile():
+    """Java/Spark reject '$' followed by a non-digit and a trailing lone
+    backslash in the replacement — so do we, at compile time."""
+    with pytest.raises(EngineException, match="illegal group reference"):
+        one_col("REGEXP_REPLACE(s, '(o)', '$z')")
+    with pytest.raises(EngineException, match="lone backslash"):
+        one_col(r"REGEXP_REPLACE(s, '(o)', 'x\')")
+
+
+def test_distinct_order_by_unselected_column_rejected():
+    """Spark raises AnalysisException for DISTINCT + ORDER BY on a column
+    not in the select list (the key would be an arbitrary row's value)."""
+    cols = {"a": [1, 1, 2], "b": [30, 10, 20]}
+    types = {"a": "long", "b": "long"}
+    with pytest.raises(EngineException, match="cannot resolve"):
+        run_select("SELECT DISTINCT a FROM T ORDER BY b", cols, types)
+    # ORDER BY on the selected column still works
+    rows, _, _ = run_select(
+        "SELECT DISTINCT a FROM T ORDER BY a DESC", cols, types
+    )
+    assert [r["a"] for r in rows] == [2, 1]
+
+
+def test_order_by_mixed_scope_expression_binds_alias_first():
+    """In ORDER BY a + b with SELECT b AS a, 'a' binds the output alias
+    (per-reference resolution) while 'b' falls back to the source."""
+    cols = {"a": [10, 0, 0], "b": [1, 2, 3]}
+    types = {"a": "long", "b": "long"}
+    rows, _, _ = run_select("SELECT b AS a FROM T ORDER BY a + b", cols, types)
+    # key = alias a (=source b) + source b = 2*b -> ascending by b
+    assert [r["a"] for r in rows] == [1, 2, 3]
+
+
+def test_regexp_replace_unicode_digit_after_dollar_rejected():
+    with pytest.raises(EngineException, match="illegal group reference"):
+        one_col("REGEXP_REPLACE(s, '(o)', '$²')")
