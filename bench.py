@@ -1,16 +1,34 @@
-"""Headline benchmark: SimulatedData IoT alerting flow throughput.
+"""Headline benchmark: SimulatedData IoT alerting flow, ingest-inclusive.
 
-Measures sustained events/sec/chip through the full per-batch path —
-vectorized ingest encode, device step (projection → threshold rule →
-5 s-window group-by), output materialization, metric computation — on
-whatever platform JAX selects (the driver runs it on one real TPU chip).
+Measures the FULL per-batch path the streaming host runs in production:
+newline-JSON bytes -> native C++ decode (native/decoder.cpp) -> host->
+device transfer -> jitted device step (projection -> threshold rule ->
+5s-window group-by) -> async device->host result transport -> row
+materialization (sink handoff point). The loop is pipelined exactly like
+StreamingHost.run_pipelined: one batch in flight, decode of batch N+1
+overlapping batch N's device step and result transport.
 
 Prints ONE JSON line:
-  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N, ...}
 
-Baseline: the reference publishes no numbers (BASELINE.md), so
-vs_baseline is measured against the north-star target's per-chip share:
-1M events/sec on a v5e-16 => 62,500 events/sec/chip.
+Reported figures:
+- value / vs_baseline: ingest-inclusive events/s/chip vs the north-star
+  per-chip share (1M ev/s on a v5e-16 => 62,500 ev/s/chip).
+- decoder_rows_per_sec / decoder_mb_per_sec: the C++ ingest decoder
+  standalone (bytes -> columnar arrays, no device involved).
+- p99_rule_eval_ms: per-batch end-to-end latency in a small-batch
+  (8192-row) pipelined loop — ingest decode to results materialized on
+  host, INCLUDING device->host result transport.
+- p99_rule_compute_ms: same loop, ingest decode to device-step
+  completion (rules evaluated, state advanced) — excludes only result
+  transport.
+- result_transport_rtt_ms: measured cost of synchronously fetching one
+  freshly-computed 4-byte scalar. On co-located hosts this is ~0; over
+  the split-host TPU tunnel this harness runs on it is a fixed network
+  round trip (~65-70 ms) that dominates p99_rule_eval_ms. The
+  decomposition is printed so the rule-eval number can be judged
+  against the north star on either topology: rule_eval ~=
+  rule_compute + transport.
 """
 
 import json
@@ -31,105 +49,150 @@ def build_processor(capacity):
     return _build(batch_capacity=capacity)
 
 
-def make_raw(proc, alert_rate=0.01, seed=3):
-    """Realistic alerting distribution: ~1% of events trip the rule."""
-    cap = proc.batch_capacity
+def make_json_payload(proc, n_rows, alert_rate=0.01, seed=3):
+    """Realistic alerting stream as newline-JSON bytes: ~1% of events
+    trip the DoorLock rule; mixed device types, jittered temps."""
     rng = np.random.RandomState(seed)
-    dd = proc.dictionary
-    type_ids = np.array(
-        [dd.encode("Heating"), dd.encode("WindSpeed"), dd.encode("DoorLock")],
-        np.int32,
-    )
-    is_door = rng.uniform(size=cap) < 2 * alert_rate
-    dtype_col = np.where(
-        is_door, type_ids[2], type_ids[rng.randint(0, 2, cap)]
-    ).astype(np.int32)
-    status = np.where(
-        is_door & (rng.uniform(size=cap) < 0.5), 0, 1
-    ).astype(np.int32)
-    cols = {}
-    for c, t in proc.raw_schema.types.items():
-        if c.endswith("deviceType"):
-            cols[c] = dtype_col
-        elif c.endswith("status"):
-            cols[c] = status
-        elif c.endswith("deviceId"):
-            cols[c] = rng.randint(1, 9, cap).astype(np.int32)
-        elif c.endswith("homeId"):
-            cols[c] = np.full(cap, 150, np.int32)
-        elif t == "double":
-            cols[c] = rng.uniform(0, 100, cap).astype(np.float32)
-    return proc.encode_columns(cols, cap)
+    types = np.array(["Heating", "WindSpeed", "DoorLock"])
+    is_door = rng.uniform(size=n_rows) < 2 * alert_rate
+    dtype_col = np.where(is_door, 2, rng.randint(0, 2, n_rows))
+    status = np.where(is_door & (rng.uniform(size=n_rows) < 0.5), 0, 1)
+    device_id = rng.randint(1, 9, n_rows)
+    temp = rng.uniform(0, 100, n_rows)
+    base = 1_700_000_000_000
+    # vectorized-ish line assembly (10x faster than json.dumps per row)
+    lines = [
+        '{"deviceDetails":{"deviceId":%d,"deviceType":"%s","homeId":150,'
+        '"status":%d,"temperature":%.3f},"eventTimeStamp":%d}'
+        % (device_id[i], types[dtype_col[i]], status[i], temp[i], base + i)
+        for i in range(n_rows)
+    ]
+    return ("\n".join(lines) + "\n").encode()
+
+
+def bench_decoder(proc, payload, n_rows, iters=8):
+    """Standalone C++ decoder throughput (bytes -> columnar arrays)."""
+    from data_accelerator_tpu.native import NativeDecoder, native_available
+
+    if not native_available():
+        return None, None
+    nd = NativeDecoder(proc.input_schema, proc.dictionary)
+    nd.decode(payload, n_rows)  # warm
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        nd.decode(payload, n_rows)
+        ts.append(time.perf_counter() - t0)
+    t = float(np.median(ts))
+    return n_rows / t, len(payload) / t / 1e6
+
+
+def pipelined_ingest_loop(proc, payloads, iters, base_ms):
+    """The production shape: decode N+1 while N computes/transports.
+
+    Returns (events/s, per-batch t0->collected ms, per-batch
+    t0->device-complete ms); t0 is taken BEFORE the decode, so every
+    figure is ingest-inclusive.
+    """
+    lat_collect, lat_compute = [], []
+    pending = None  # (handle, t0)
+    t_start = time.perf_counter()
+    for i in range(iters):
+        t0 = time.perf_counter()
+        raw = proc.encode_json_bytes(
+            payloads[i % len(payloads)], base_ms + i * 1000
+        )
+        handle = proc.dispatch_batch(raw, batch_time_ms=base_ms + i * 1000)
+        if pending is not None:
+            ph, pt0 = pending
+            ph.block_until_evaluated()
+            lat_compute.append((time.perf_counter() - pt0) * 1000.0)
+            ph.collect()
+            lat_collect.append((time.perf_counter() - pt0) * 1000.0)
+        pending = (handle, t0)
+    ph, pt0 = pending
+    ph.block_until_evaluated()
+    lat_compute.append((time.perf_counter() - pt0) * 1000.0)
+    ph.collect()
+    lat_collect.append((time.perf_counter() - pt0) * 1000.0)
+    total_s = time.perf_counter() - t_start
+    events = proc.batch_capacity * iters
+    return events / total_s, lat_collect, lat_compute
+
+
+def measure_transport_rtt(iters=15):
+    """Synchronous fetch cost of one freshly-computed 4-byte scalar —
+    isolates the device->host transport the harness topology imposes."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda a: a.sum())
+    x = jnp.zeros(128, jnp.int32)
+    float(np.asarray(f(x)))  # warm/compile
+    ts = []
+    for _ in range(iters):
+        r = f(x)
+        t0 = time.perf_counter()
+        np.asarray(r)
+        ts.append((time.perf_counter() - t0) * 1000.0)
+    return float(np.median(ts))
 
 
 def main():
     import jax
 
     backend = jax.default_backend()
-    # 512k rows/batch balances per-chip throughput (~1.4M ev/s on v5e,
-    # 22x the north-star per-chip share) against batch p99 (~0.4 s);
-    # larger batches keep gaining throughput but trade away latency
     capacity = int(os.environ.get(
-        "BENCH_CAPACITY", "524288" if backend != "cpu" else "65536"
+        "BENCH_CAPACITY", "262144" if backend != "cpu" else "65536"
     ))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
-    iters = int(os.environ.get("BENCH_ITERS", "20"))
-
-    proc = build_processor(capacity)
-    raw = make_raw(proc)
-
+    iters = int(os.environ.get("BENCH_ITERS", "12"))
     base_ms = 1_700_000_000_000
+
+    # -- throughput: ingest-inclusive pipelined loop ---------------------
+    proc = build_processor(capacity)
+    payloads = [
+        make_json_payload(proc, capacity, seed=3 + j) for j in range(2)
+    ]
+    dec_rows_s, dec_mb_s = bench_decoder(proc, payloads[0], capacity)
     for i in range(warmup):
-        proc.process_batch(raw, batch_time_ms=base_ms + i * 1000)
+        raw = proc.encode_json_bytes(payloads[0], base_ms - 60_000 + i * 1000)
+        proc.process_batch(raw, batch_time_ms=base_ms - 60_000 + i * 1000)
+    eps, lat_collect, _ = pipelined_ingest_loop(
+        proc, payloads, iters, base_ms
+    )
+    p99_batch = float(np.percentile(lat_collect, 99))
 
-    # pipelined loop: one batch in flight — dispatch N+1 while N's
-    # transfer/materialization completes (the streaming host's
-    # run_pipelined shape)
-    lat_ms = []
-    t_start = time.perf_counter()
-    pending = None
-    t_disp = t_start
-    for i in range(iters):
-        handle = proc.dispatch_batch(
-            raw, batch_time_ms=base_ms + (warmup + i) * 1000
-        )
-        if pending is not None:
-            pending.collect()
-            lat_ms.append((time.perf_counter() - t_disp) * 1000.0)
-        pending = handle
-        t_disp = time.perf_counter()
-    pending.collect()
-    lat_ms.append((time.perf_counter() - t_disp) * 1000.0)
-    total_s = time.perf_counter() - t_start
-
-    events = capacity * iters
-    eps = events / total_s
-    p99 = float(np.percentile(lat_ms, 99))
-
-    # latency mode: small batches, synchronous — the p99 rule-eval
-    # latency figure of the north star (rule evaluation end-to-end for
-    # one micro-batch, not the throughput-tuned big batch)
+    # -- latency mode: small batches, same pipelined ingest path ---------
     lat_cap = int(os.environ.get("BENCH_LATENCY_CAPACITY", "8192"))
     lproc = build_processor(lat_cap)
-    lraw = make_raw(lproc, seed=5)
+    lpayloads = [
+        make_json_payload(lproc, lat_cap, seed=11 + j) for j in range(2)
+    ]
     for i in range(3):
-        lproc.process_batch(lraw, batch_time_ms=base_ms + 900_000 + i * 1000)
-    rule_ms = []
-    for i in range(20):
-        t0 = time.perf_counter()
-        lproc.process_batch(
-            lraw, batch_time_ms=base_ms + 910_000 + i * 1000
+        lraw = lproc.encode_json_bytes(
+            lpayloads[0], base_ms + 900_000 + i * 1000
         )
-        rule_ms.append((time.perf_counter() - t0) * 1000.0)
-    p99_rule = float(np.percentile(rule_ms, 99))
+        lproc.process_batch(lraw, batch_time_ms=base_ms + 900_000 + i * 1000)
+    _, rule_eval_ms, rule_compute_ms = pipelined_ingest_loop(
+        lproc, lpayloads, 24, base_ms + 910_000
+    )
+    p99_rule = float(np.percentile(rule_eval_ms, 99))
+    p99_compute = float(np.percentile(rule_compute_ms, 99))
+
+    rtt = measure_transport_rtt()
 
     print(json.dumps({
-        "metric": "iot_alerting_events_per_sec_per_chip",
+        "metric": "iot_alerting_events_per_sec_per_chip_ingest_inclusive",
         "value": round(eps, 1),
         "unit": "events/s",
         "vs_baseline": round(eps / PER_CHIP_TARGET, 3),
-        "p99_batch_ms": round(p99, 2),
+        "p99_batch_ms": round(p99_batch, 2),
         "p99_rule_eval_ms": round(p99_rule, 2),
+        "p99_rule_compute_ms": round(p99_compute, 2),
+        "result_transport_rtt_ms": round(rtt, 2),
+        "decoder_rows_per_sec": round(dec_rows_s, 1) if dec_rows_s else None,
+        "decoder_mb_per_sec": round(dec_mb_s, 1) if dec_mb_s else None,
         "backend": backend,
         "batch_capacity": capacity,
     }))

@@ -598,11 +598,18 @@ class FlowProcessor:
         if new_ring is not None:
             self.window_buffers["__ring"] = new_ring
         self.state_data = new_state
-        return PendingBatch(
+        handle = PendingBatch(
             self, self.pipeline, out_datasets, new_state, counts_vec,
             batch_time_ms, new_base_ms, t0,
             out_names=list(self.output_datasets),
         )
+        # begin the device->host result copies NOW (async enqueue, free):
+        # by the time collect() runs — typically one pipelined iteration
+        # later — the data has already crossed the boundary, so collect
+        # pays no synchronous transport round trip. On split hosts that
+        # round trip is a network RTT, the single largest per-batch cost.
+        handle.start_fetch()
+        return handle
 
     def process_batch(
         self, raw: TableData, batch_time_ms: Optional[int] = None
@@ -652,21 +659,49 @@ class PendingBatch:
         self.batch_time_ms = batch_time_ms
         self.base_ms = base_ms
         self.t0 = t0
+        self._prefetched = False
+
+    def start_fetch(self) -> None:
+        """Enqueue async device->host copies of everything collect()
+        reads (counts + compacted output tables). Transport then
+        overlaps the host's next-batch work instead of being paid as a
+        blocking sync inside collect(). Transfers are latency-bound,
+        not byte-bound, on split hosts — so the whole (compacted)
+        tables are streamed rather than syncing counts first and
+        slicing device-side, which would cost a second round trip."""
+        try:
+            self.counts_vec.copy_to_host_async()
+            for t in self.out_datasets.values():
+                for a in t.cols.values():
+                    if hasattr(a, "copy_to_host_async"):
+                        a.copy_to_host_async()
+                t.valid.copy_to_host_async()
+        except Exception:  # noqa: BLE001 — backend-dependent capability
+            return  # no async host copies here; collect() syncs instead
+        self._prefetched = True
+
+    def block_until_evaluated(self) -> None:
+        """Wait for the device step to COMPLETE (rule evaluation done,
+        state advanced) without transferring results — the honest
+        'rules evaluated' timestamp, independent of result transport."""
+        jax.block_until_ready(self.counts_vec)
 
     def collect(self) -> Tuple[Dict[str, List[dict]], Dict[str, float]]:
         """Sync, transfer, materialize; returns (datasets, metrics).
 
-        ONE host sync for every per-batch scalar (layout: input count,
-        per-output counts, per-output overflow slots), then the
-        device-compacted outputs are sliced to their true row counts so
-        only real rows cross the device->host boundary, fetched in one
-        batched device_get (transfers overlap).
+        With a prior ``start_fetch()`` (the default from
+        ``dispatch_batch``) every read below hits an already-landed host
+        copy. Otherwise: ONE host sync for every per-batch scalar
+        (layout: input count, per-output counts, per-output overflow
+        slots), then the device-compacted outputs are sliced to their
+        true row counts so only real rows cross the device->host
+        boundary, fetched in one batched device_get.
         """
         proc = self.proc
-        if proc.batch_capacity <= SMALL_FETCH_ROWS:
-            # latency mode: batches this small transfer whole-table in
-            # ONE round-trip (counts + outputs together) — the extra
-            # bytes cost less than a second host<->device sync
+        if self._prefetched or proc.batch_capacity <= SMALL_FETCH_ROWS:
+            # whole-table transfer in ONE round trip (counts + outputs
+            # together) — prefetched at dispatch, or small enough that
+            # the extra bytes cost less than a second host<->device sync
             counts, host_full = jax.device_get(
                 (self.counts_vec, self.out_datasets)
             )
