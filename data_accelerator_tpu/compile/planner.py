@@ -237,10 +237,14 @@ def _has_aggregate(e: Expr) -> bool:
 class PlannerConfig:
     join_capacity_factor: float = 1.0  # out_cap = factor * max(left, right)
     min_join_capacity: int = 64
+    # flow-configured absolute join output bound (conf
+    # process.joincapacity); overrides the factor sizing when set
+    join_capacity: Optional[int] = None
     # grouped outputs are compacted to the front, so their capacity can be
     # bounded below the input capacity — this is what keeps downstream
     # shapes small when grouping huge windowed tables (groups beyond the
-    # bound drop; the runtime surfaces overflow as a metric)
+    # bound drop; the runtime surfaces overflow as a metric, and the
+    # flow sets the bound via conf process.maxgroups)
     max_group_capacity: int = 4096
 
 
@@ -408,6 +412,13 @@ class SelectCompiler:
                 env2 = EvalEnv(scopes, base_s, now_rel_ms, shape)
                 keys = [k.fn(env2) for k in distinct_keys]
                 valid = distinct_mask(keys, valid)
+            meta = scopes.get("__meta")
+            if meta is not None and "join_dropped" in meta:
+                # rows lost to the join capacity bound ride along as a
+                # hidden column -> Output_<n>_JoinRowsDropped metric
+                cols["__overflow.joins"] = jnp.broadcast_to(
+                    meta["join_dropped"], shape
+                )
             return TableData(cols, valid)
 
         schema = ViewSchema(out_types, deferred)
@@ -502,6 +513,7 @@ class SelectCompiler:
             b0, n0, sch0 = bindings[0]
             acc_cols = {(b0, c): tables[n0].cols[c] for c in sch0.types}
             acc_valid = tables[n0].valid
+            acc_dropped = jnp.asarray(0, jnp.int32)
 
             for j, jb, eq_pairs, residual, lbs in join_plans:
                 rb, rn, rsch = jb
@@ -536,14 +548,15 @@ class SelectCompiler:
                         return residual.fn(env2)
 
                 if j.kind == "LEFT":
-                    li, ri, valid, is_null = left_join_indices(
+                    li, ri, valid, is_null, dropped = left_join_indices(
                         lkeys, rkeys, acc_valid, right.valid, out_cap, res_fn
                     )
                 else:
-                    li, ri, valid = inner_join_indices(
+                    li, ri, valid, dropped = inner_join_indices(
                         lkeys, rkeys, acc_valid, right.valid, out_cap, res_fn
                     )
                     is_null = None
+                acc_dropped = acc_dropped + dropped
 
                 new_cols = {}
                 for (b, c), arr in acc_cols.items():
@@ -561,6 +574,10 @@ class SelectCompiler:
             for (b, c), arr in acc_cols.items():
                 final_scopes[""][merged_name(b, c)] = arr
                 final_scopes.setdefault(b, {})[c] = arr
+            # pairs lost to the join capacity bound ride along as scope
+            # metadata (never row-shaped) so the output view can surface
+            # them as an overflow column for the runtime's metric
+            final_scopes["__meta"] = {"join_dropped": acc_dropped}
             return final_scopes, acc_valid, acc_valid.shape
 
         # scope: merged columns under "" plus per-binding scopes
@@ -573,6 +590,8 @@ class SelectCompiler:
         return scope, build, out_cap
 
     def _join_capacity(self, sel: Select) -> int:
+        if self.config.join_capacity is not None:
+            return self.config.join_capacity
         caps = [self.capacities[sel.from_table.name]] + [
             self.capacities[j.table.name] for j in sel.joins
         ]
@@ -1129,7 +1148,8 @@ class SelectCompiler:
             rep_scopes = {
                 b: {c: arr[rep_idx] for c, arr in cols.items()}
                 for b, cols in scopes.items()
-                if b != "__aux"  # dictionary tables are not row-shaped
+                # dictionary tables / join metadata are not row-shaped
+                if b not in ("__aux", "__meta")
             }
             rep_scopes["__agg"] = agg_results
             rep_scopes["__aux"] = aux_tables
@@ -1144,6 +1164,11 @@ class SelectCompiler:
             # emit it as an overflow metric (Output_<n>_GroupsDropped)
             dropped = jnp.maximum(num_groups - capacity, 0).astype(jnp.int32)
             cols["__overflow.groups"] = jnp.broadcast_to(dropped, (capacity,))
+            meta = scopes.get("__meta")
+            if meta is not None and "join_dropped" in meta:
+                cols["__overflow.joins"] = jnp.broadcast_to(
+                    meta["join_dropped"], (capacity,)
+                )
             return TableData(cols, out_valid)
 
         schema = ViewSchema(out_types, deferred)

@@ -70,10 +70,15 @@ def step_shardings(mesh: Mesh):
     """(in_shardings, out_shardings) pytree prefixes for
     ``FlowProcessor``'s step signature:
 
-    in:  (raw, ring, state, refdata, base_s, now_rel_ms, slot, delta_ms,
-          aux string-op dictionary tables — replicated: every chip gathers
-          locally, like a broadcast join side)
-    out: (datasets, new_ring, new_state, counts_vec)
+    in:  (raw tables per source — rows shard, rings per windowed table —
+          capacity dim shards, state, refdata, base_s, now_rel_ms,
+          counter, delta_ms, aux string-op dictionary tables —
+          replicated: every chip gathers locally, like a broadcast join
+          side)
+    out: (datasets, new_rings, new_state, counts_vec)
+
+    The prefixes apply leaf-wise over the dict pytrees, so N sources and
+    N rings inherit the same layout without per-flow sharding code.
     """
     row = row_sharding(mesh)
     ring = ring_sharding(mesh)

@@ -27,18 +27,19 @@ def inner_join_indices(
     right_valid: jnp.ndarray,
     out_capacity: int,
     residual: Optional[Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]] = None,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Return (left_idx[out], right_idx[out], valid[out]) of matching pairs.
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Return (left_idx[out], right_idx[out], valid[out], dropped) of
+    matching pairs.
 
     left_keys/right_keys: sequences of [n] / [m] arrays (conjunctive
     equality). ``residual``: optional extra predicate evaluated pairwise on
     (left_row_idx_matrix, right_row_idx_matrix) -> [n, m] bool, for
     non-equi ON terms.
 
-    Pairs beyond ``out_capacity`` are dropped (the planner sizes capacity
-    to the flow's configured bound and the runtime counts overflow as a
-    metric rather than failing, matching at-least-once streaming
-    semantics).
+    Pairs beyond ``out_capacity`` are dropped; ``dropped`` (scalar int32)
+    counts them, and the planner rides it through to the runtime so the
+    flow emits an ``Output_<n>_JoinRowsDropped`` metric rather than
+    failing, matching at-least-once streaming semantics.
     """
     n = left_valid.shape[0]
     m = right_valid.shape[0]
@@ -51,12 +52,14 @@ def inner_join_indices(
         match = match & residual(li, ri)
 
     flat = match.reshape(-1)
+    total = jnp.sum(flat.astype(jnp.int32))
+    dropped = jnp.maximum(total - jnp.int32(out_capacity), 0)
     (pair_idx,) = jnp.nonzero(flat, size=out_capacity, fill_value=-1)
     valid = pair_idx >= 0
     pair_idx = jnp.where(valid, pair_idx, 0)
     left_idx = pair_idx // m
     right_idx = pair_idx % m
-    return left_idx, right_idx, valid
+    return left_idx, right_idx, valid, dropped
 
 
 def left_join_indices(
@@ -66,12 +69,13 @@ def left_join_indices(
     right_valid: jnp.ndarray,
     out_capacity: int,
     residual=None,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """LEFT OUTER variant: also emits unmatched left rows once.
 
-    Returns (left_idx, right_idx, valid, right_is_null): where
+    Returns (left_idx, right_idx, valid, right_is_null, dropped): where
     ``right_is_null`` marks rows whose right side carries no match (their
-    right columns must be nulled by the caller).
+    right columns must be nulled by the caller) and ``dropped`` (scalar
+    int32) counts output rows lost to the capacity bound.
     """
     n = left_valid.shape[0]
     m = right_valid.shape[0]
@@ -88,6 +92,8 @@ def left_join_indices(
     # matched pairs followed by unmatched-left singles, in one index space:
     # pair space [n*m] then singles space [n]
     flat = jnp.concatenate([match.reshape(-1), unmatched])
+    total = jnp.sum(flat.astype(jnp.int32))
+    dropped = jnp.maximum(total - jnp.int32(out_capacity), 0)
     (idx,) = jnp.nonzero(flat, size=out_capacity, fill_value=-1)
     valid = idx >= 0
     idx = jnp.where(valid, idx, 0)
@@ -95,4 +101,4 @@ def left_join_indices(
     pair_idx = jnp.where(is_single, 0, idx)
     left_idx = jnp.where(is_single, idx - n * m, pair_idx // m)
     right_idx = jnp.where(is_single, 0, pair_idx % m)
-    return left_idx, right_idx, valid, is_single
+    return left_idx, right_idx, valid, is_single, dropped

@@ -100,3 +100,90 @@ class OffsetCheckpointer:
                 now, source, part, from_seq, until_seq
             )
         self.write_offsets(list(merged.values()))
+
+
+class WindowStateCheckpointer:
+    """Persist/restore the device window ring buffers across restarts.
+
+    The offsets file above only replays the LAST batch; TIMEWINDOW ring
+    buffers hold up to window+watermark of history that a restart would
+    otherwise silently zero. The reference keeps that state in the Spark
+    StreamingContext checkpoint (datax-host host/StreamingHost.scala:83-89
+    ``StreamingContext.getOrCreate(checkpointDir, ...)``); here the rings
+    are plain arrays, so the snapshot is one ``window.npz`` written with
+    the same atomic-replace + ``.old`` backup semantics as offsets.txt.
+
+    Serialized layout (all numpy): per ring table
+    ``ring/<table>/col/<name>`` + ``ring/<table>/valid``, plus the slot
+    counter and the time base the ring's relative timestamps refer to.
+    """
+
+    FILE = "window.npz"
+    BACKUP = "window.npz.old"
+
+    def __init__(self, checkpoint_dir: str):
+        self.dir = checkpoint_dir
+        os.makedirs(checkpoint_dir, exist_ok=True)
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.dir, self.FILE)
+
+    @property
+    def backup_path(self) -> str:
+        return os.path.join(self.dir, self.BACKUP)
+
+    def save(self, snap: Dict) -> None:
+        """snap: FlowProcessor.snapshot_window_state() output."""
+        import numpy as np
+
+        arrays: Dict[str, "np.ndarray"] = {}
+        for table, ring in snap.get("rings", {}).items():
+            for c, a in ring["cols"].items():
+                arrays[f"ring/{table}/col/{c}"] = a
+            arrays[f"ring/{table}/valid"] = ring["valid"]
+        arrays["slot_counter"] = np.asarray(
+            int(snap.get("slot_counter", 0)), np.int64
+        )
+        base = snap.get("base_ms")
+        arrays["base_ms"] = np.asarray(
+            -1 if base is None else int(base), np.int64
+        )
+        if os.path.exists(self.path):
+            shutil.copyfile(self.path, self.backup_path)
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, self.path)
+
+    def load(self) -> Optional[Dict]:
+        """Restore a snapshot dict, falling back to the backup; None when
+        no (readable) snapshot exists."""
+        import numpy as np
+
+        for path in (self.path, self.backup_path):
+            if not os.path.exists(path):
+                continue
+            try:
+                with np.load(path) as z:
+                    rings: Dict[str, Dict] = {}
+                    for key in z.files:
+                        if not key.startswith("ring/"):
+                            continue
+                        _, table, kind = key.split("/", 2)
+                        ring = rings.setdefault(
+                            table, {"cols": {}, "valid": None}
+                        )
+                        if kind == "valid":
+                            ring["valid"] = z[key]
+                        else:
+                            ring["cols"][kind.split("/", 1)[1]] = z[key]
+                    base = int(z["base_ms"])
+                    return {
+                        "rings": rings,
+                        "slot_counter": int(z["slot_counter"]),
+                        "base_ms": None if base < 0 else base,
+                    }
+            except Exception:
+                continue
+        return None

@@ -22,7 +22,7 @@ from ..core.config import SettingDictionary, SettingNamespace
 from ..core.confmanager import ConfigManager
 from ..obs import telemetry
 from ..obs.metrics import MetricLogger
-from .checkpoint import OffsetCheckpointer
+from .checkpoint import OffsetCheckpointer, WindowStateCheckpointer
 from .processor import FlowProcessor
 from .sinks import OutputDispatcher, build_output_operators
 from .sources import LocalSource, StreamingSource, make_source
@@ -46,7 +46,18 @@ class StreamingHost:
         self.telemetry = telemetry.from_conf(dict_)
 
         input_conf = dict_.get_sub_dictionary(SettingNamespace.JobInputPrefix)
-        self.source = source or make_source(input_conf, self.processor.input_schema)
+        # one StreamingSource per declared input source (multi-source
+        # flows poll them all each batch; the injected ``source`` arg
+        # binds to the primary for back-compat / tests)
+        self.sources: Dict[str, StreamingSource] = {}
+        for name, spec in self.processor.specs.items():
+            if name == self.processor.primary and source is not None:
+                self.sources[name] = source
+            else:
+                self.sources[name] = make_source(
+                    spec.conf, spec.schema, source=name
+                )
+        self.source = self.sources[self.processor.primary]
         self.interval_s = self.processor.interval_s
         self.max_rate = int(input_conf.get_or_else("eventhub.maxrate", "1000"))
         # backpressure: when a batch overruns the interval, shrink the
@@ -75,12 +86,34 @@ class StreamingHost:
         self.checkpointer = (
             OffsetCheckpointer(ckpt_dir) if ckpt_dir else None
         )
+        # window-state checkpointing (SURVEY §5.4): the offsets file only
+        # replays the last batch; ring buffers hold up to window+watermark
+        # of history that a restart would silently zero. Persist them on
+        # the same cadence and restore on start (the role the Spark
+        # StreamingContext checkpoint plays at StreamingHost.scala:83-89).
+        self.window_checkpointer = (
+            WindowStateCheckpointer(ckpt_dir)
+            if ckpt_dir and self.processor.window_buffers
+            else None
+        )
         self.checkpoint_interval_s = (
             input_conf.get_duration_option("eventhub.checkpointinterval") or 60.0
         )
         self._last_checkpoint = 0.0
         if self.checkpointer:
-            self.source.start(self.checkpointer.starting_positions())
+            positions = self.checkpointer.starting_positions()
+            for s in self.sources.values():
+                s.start(positions)
+        if self.window_checkpointer:
+            snap = self.window_checkpointer.load()
+            if snap is not None:
+                if self.processor.restore_window_state(snap):
+                    logger.info("restored window state from checkpoint")
+                else:
+                    logger.warning(
+                        "window-state checkpoint incompatible with current "
+                        "flow config; starting with empty windows"
+                    )
 
         # sink routing: dataset -> output names; default: each conf output
         # name routes its same-named dataset (S500 contract)
@@ -97,29 +130,44 @@ class StreamingHost:
 
     # -- loop -------------------------------------------------------------
     def _poll_and_encode(self):
-        """Poll the source and encode one device batch; returns
-        (raw, consumed offsets, batch_time_ms, t0)."""
+        """Poll every source and encode one device batch per source;
+        returns (raw dict, consumed offsets, batch_time_ms, t0)."""
         t0 = time.time()
         batch_time_ms = int(t0 * 1000)
-        max_events = min(
-            self.processor.batch_capacity,
-            max(1, int(self.max_rate * self.interval_s * self._rate_scale)),
-        )
-        if isinstance(self.source, LocalSource):
-            cols, now_ms, consumed = self.source.poll_columns(
-                max_events, self.processor.dictionary
+        raw: Dict[str, object] = {}
+        consumed: Dict = {}
+        for name, src in self.sources.items():
+            spec = self.processor.specs[name]
+            max_events = min(
+                spec.capacity,
+                max(1, int(self.max_rate * self.interval_s * self._rate_scale)),
             )
-            raw = self.processor.encode_columns(cols, max_events)
-            batch_time_ms = now_ms
-        elif hasattr(self.source, "poll_raw"):
-            # native ingest: raw JSON bytes -> C++ decoder -> device
-            blob, _n, consumed = self.source.poll_raw(max_events)
-            raw = self.processor.encode_json_bytes(
-                blob, (batch_time_ms // 1000) * 1000
-            )
-        else:
-            rows, consumed = self.source.poll(max_events)
-            raw = self.processor.encode_rows(rows, (batch_time_ms // 1000) * 1000)
+            if isinstance(src, LocalSource):
+                cols, now_ms, c = src.poll_columns(
+                    max_events, self.processor.dictionary
+                )
+                raw[name] = self.processor.encode_columns(
+                    cols, max_events, source=name
+                )
+                if len(self.sources) == 1:
+                    # single-source fast path: the generator's clock IS
+                    # the batch time. Multi-source keeps the one t0 base
+                    # computed above — every stream must encode against
+                    # the SAME base the dispatch will use, or relative
+                    # timestamps shift across a second boundary
+                    batch_time_ms = now_ms
+            elif hasattr(src, "poll_raw"):
+                # native ingest: raw JSON bytes -> C++ decoder -> device
+                blob, _n, c = src.poll_raw(max_events)
+                raw[name] = self.processor.encode_json_bytes(
+                    blob, (batch_time_ms // 1000) * 1000, source=name
+                )
+            else:
+                rows, c = src.poll(max_events)
+                raw[name] = self.processor.encode_rows(
+                    rows, (batch_time_ms // 1000) * 1000, source=name
+                )
+            consumed.update(c)
         return raw, consumed, batch_time_ms, t0
 
     def _finish(self, handle, consumed, batch_time_ms, t0) -> Dict[str, float]:
@@ -131,12 +179,14 @@ class StreamingHost:
             datasets, metrics = handle.collect()
             self.dispatcher.dispatch(datasets, batch_time_ms)
             self.processor.commit()
-            self.source.ack()
+            for s in self.sources.values():
+                s.ack()
         except Exception as e:
             self.telemetry.track_exception(
                 e, {"event": "error/streaming/process", "batchTime": batch_time_ms}
             )
-            self.source.requeue_unacked()
+            for s in self.sources.values():
+                s.requeue_unacked()
             logger.exception("batch processing failed; rethrowing for retry")
             raise
 
@@ -152,6 +202,15 @@ class StreamingHost:
         if self.checkpointer and (
             t0 - self._last_checkpoint >= self.checkpoint_interval_s
         ):
+            if self.window_checkpointer:
+                # snapshot BEFORE offsets: a crash between the two leaves
+                # old offsets + new rings, so replayed batches land in
+                # rings that already contain them (at-least-once
+                # duplicates); the reverse order would resume PAST events
+                # the restored rings never saw — a hole in window history
+                self.window_checkpointer.save(
+                    self.processor.snapshot_window_state()
+                )
             self.checkpointer.checkpoint_batch(consumed)
             self._last_checkpoint = t0
         self.batches_processed += 1
@@ -189,7 +248,8 @@ class StreamingHost:
             self.telemetry.batch_begin(batch_time_ms)
             handle = self.processor.dispatch_batch(raw, batch_time_ms)
         except Exception:
-            self.source.requeue_unacked()
+            for s in self.sources.values():
+                s.requeue_unacked()
             raise
         return handle, consumed, batch_time_ms, t0
 
@@ -275,7 +335,8 @@ class StreamingHost:
     def stop(self) -> None:
         self._stop = True
         self._stop_profiler()
-        self.source.close()
+        for s in self.sources.values():
+            s.close()
 
 
 def main(argv=None):

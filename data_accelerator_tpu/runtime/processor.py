@@ -11,6 +11,14 @@ window update, the whole SQL pipeline, state-table production and count
 metrics — compiles into ONE jitted step function. The host loop only
 encodes ingest, invokes the step, materializes output datasets, and runs
 sinks/checkpoints.
+
+Multi-source flows (reference: the ``input.sources`` map in
+flattenerConfig.json and the per-source grouping in
+input/BlobPointerInput.scala:30-160): ``datax.job.input.sources.<name>.*``
+declares N named sources, each with its own schema and projection into
+its own named table; time windows may target any of those tables, so a
+flow can join two independent streams across sliding windows — all still
+inside the single jitted step.
 """
 
 from __future__ import annotations
@@ -20,7 +28,7 @@ import logging
 import os
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -134,6 +142,28 @@ def _infer_csv_type(vals: List[str]) -> str:
         return "string"
 
 
+@dataclass
+class SourceSpec:
+    """One named input stream of a flow: its schema, projection chain,
+    the table its projected rows land in, and its batch capacity.
+
+    reference: one entry of the flattener's ``input.sources`` map
+    (DataX.Config.Local/Resources/flattenerConfig.json) — per-source
+    schema + normalization snippet + target table.
+    """
+
+    name: str
+    target: str
+    schema: Schema
+    raw_schema: ViewSchema
+    projection_steps: List[str]
+    capacity: int
+    conf: SettingDictionary
+
+
+DEFAULT_SOURCE = "default"
+
+
 class FlowProcessor:
     """Compiled per-flow processor. Build once; call process_batch per
     micro-batch (the closure the reference builds at
@@ -159,41 +189,66 @@ class FlowProcessor:
 
         input_conf = dict_.get_sub_dictionary(SettingNamespace.JobInputPrefix)
         process_conf = dict_.get_sub_dictionary(SettingNamespace.JobProcessPrefix)
-
-        # input schema
-        schema_text = _read_maybe_file(input_conf.get("blobschemafile"))
-        if schema_text is None:
-            raise ValueError("input schema (blobschemafile) is required")
-        self.input_schema = Schema.from_spark_json(schema_text)
+        self.process_conf = process_conf
 
         self.interval_s = float(
             input_conf.get_or_else("streaming.intervalinseconds", "1")
         )
         max_rate = int(input_conf.get_or_else("eventhub.maxrate", "1000"))
-        self.batch_capacity = batch_capacity or int(
-            input_conf.get_or_else(
-                "streaming.maxbatchsize", str(max(64, int(max_rate * self.interval_s)))
+        # flow-level default batch capacity: ctor arg > process conf
+        # (generation.py S400 writes process.batchcapacity) > input conf
+        default_capacity = (
+            batch_capacity
+            or process_conf.get_int_option("batchcapacity")
+            or int(
+                input_conf.get_or_else(
+                    "streaming.maxbatchsize",
+                    str(max(64, int(max_rate * self.interval_s))),
+                )
             )
         )
-        if self.mesh is not None:
-            # row shards must divide evenly over the data axis
-            n = self.mesh.size
-            self.batch_capacity = ((self.batch_capacity + n - 1) // n) * n
 
         self.timestamp_column = process_conf.get("timestampcolumn")
         self.watermark_s = process_conf.get_duration_option("watermark") or 0.0
 
-        # raw-table schema: input columns + Properties/SystemProperties
-        raw_types = dict(schema_to_view(self.input_schema).types)
-        raw_types.setdefault(ColumnName.RawPropertiesColumn, "string")
-        raw_types.setdefault(ColumnName.RawSystemPropertiesColumn, "string")
-        self.raw_schema = ViewSchema(raw_types)
+        # planner capacities are flow conf, not constants: maxgroups
+        # bounds GROUP BY fan-out, joincapacity bounds join output rows
+        # (both surface overflow as metrics rather than failing)
+        self.planner_config = self._planner_config(process_conf)
 
-        # projection: selectExpr lines (handler/ProjectionHandler.scala)
-        projections = process_conf.get_string_seq_option("projection") or []
-        self.projection_steps = [
-            _read_maybe_file(p) for p in projections
-        ] or [self._default_projection()]
+        # -- named sources ------------------------------------------------
+        self.specs: Dict[str, SourceSpec] = {}
+        source_groups = dict_.group_by_sub_namespace(
+            SettingNamespace.JobPrefix + "input.sources."
+        )
+        global_projection = process_conf.get_string_seq_option("projection")
+        if source_groups:
+            for sname, sub in source_groups.items():
+                self.specs[sname] = self._make_spec(
+                    sname, sub, default_capacity,
+                    # the flow-level projection applies to the default
+                    # source only; others declare their own
+                    global_projection if sname == DEFAULT_SOURCE else None,
+                )
+        else:
+            self.specs[DEFAULT_SOURCE] = self._make_spec(
+                DEFAULT_SOURCE, input_conf, default_capacity, global_projection
+            )
+        targets = [s.target for s in self.specs.values()]
+        if len(set(targets)) != len(targets):
+            raise EngineException(
+                f"input sources project into duplicate tables: {targets}"
+            )
+
+        # back-compat single-source surface: the primary spec
+        self.primary = (
+            DEFAULT_SOURCE if DEFAULT_SOURCE in self.specs
+            else next(iter(self.specs))
+        )
+        primary = self.specs[self.primary]
+        self.input_schema = primary.schema
+        self.raw_schema = primary.raw_schema
+        self.batch_capacity = primary.capacity
 
         # transform
         transform_text = _read_maybe_file(process_conf.get("transform")) or ""
@@ -202,12 +257,20 @@ class FlowProcessor:
         # reference data
         self.refdata = load_reference_data_tables(dict_, self.dictionary)
 
-        # time windows (handler/TimeWindowHandler.scala:23-68)
-        self.windows: Dict[str, float] = {}
+        # time windows (handler/TimeWindowHandler.scala:23-68); each
+        # window targets one projected table (conf `table`, else the
+        # longest target that prefixes the window name, else the default)
+        self.windows: Dict[str, Tuple[str, float]] = {}
         for wname, sub in dict_.group_by_sub_namespace(
             SettingNamespace.JobProcessPrefix + "timewindow."
         ).items():
-            self.windows[wname] = sub.get_duration("windowduration")
+            table = sub.get("table") or self._window_target(wname, targets)
+            if table not in targets:
+                raise EngineException(
+                    f"timewindow {wname} targets unknown table {table!r} "
+                    f"(projected tables: {targets})"
+                )
+            self.windows[wname] = (table, sub.get_duration("windowduration"))
 
         # state tables
         self.state_tables: Dict[str, StateTable] = {}
@@ -225,11 +288,97 @@ class FlowProcessor:
         self._jit_step()
 
     # -- build -----------------------------------------------------------
-    def _default_projection(self) -> str:
+    def _planner_config(self, process_conf: SettingDictionary) -> PlannerConfig:
+        kwargs = {}
+        maxgroups = (
+            process_conf.get_int_option("maxgroups")
+            or process_conf.get_int_option("groupcapacity")
+        )
+        if maxgroups is not None:
+            if maxgroups < 1:
+                raise EngineException(
+                    f"process.maxgroups must be >= 1, got {maxgroups}"
+                )
+            kwargs["max_group_capacity"] = maxgroups
+        joincap = process_conf.get_int_option("joincapacity")
+        if joincap is not None:
+            if joincap < 1:
+                raise EngineException(
+                    f"process.joincapacity must be >= 1, got {joincap}"
+                )
+            kwargs["join_capacity"] = joincap
+        return PlannerConfig(**kwargs)
+
+    def _make_spec(
+        self,
+        name: str,
+        conf: SettingDictionary,
+        default_capacity: int,
+        global_projection: Optional[List[str]],
+    ) -> SourceSpec:
+        schema_text = _read_maybe_file(conf.get("blobschemafile"))
+        if schema_text is None:
+            raise ValueError(
+                f"input schema (blobschemafile) is required for source {name!r}"
+            )
+        schema = Schema.from_spark_json(schema_text)
+
+        capacity = (
+            conf.get_int_option("streaming.maxbatchsize") or default_capacity
+        )
+        if self.mesh is not None:
+            # row shards must divide evenly over the data axis
+            n = self.mesh.size
+            capacity = ((capacity + n - 1) // n) * n
+
+        target = conf.get("target") or (
+            DatasetName.DataStreamProjection if name == DEFAULT_SOURCE else name
+        )
+
+        raw_types = dict(schema_to_view(schema).types)
+        raw_types.setdefault(ColumnName.RawPropertiesColumn, "string")
+        raw_types.setdefault(ColumnName.RawSystemPropertiesColumn, "string")
+        raw_schema = ViewSchema(raw_types)
+
+        # projection: selectExpr lines (handler/ProjectionHandler.scala);
+        # per-source `projection` conf wins, then the flow-level one for
+        # the default source, then the normalization default
+        projections = (
+            conf.get_string_seq_option("projection") or global_projection or []
+        )
+        steps = [_read_maybe_file(p) for p in projections] or [
+            self._default_projection(schema)
+        ]
+        return SourceSpec(
+            name=name,
+            target=target,
+            schema=schema,
+            raw_schema=raw_schema,
+            projection_steps=steps,
+            capacity=capacity,
+            conf=conf,
+        )
+
+    @staticmethod
+    def _window_target(wname: str, targets: List[str]) -> str:
+        """Bind a window name to its projected table: the longest target
+        ``T`` such that the window is named ``T_<duration>``. A
+        single-source flow may name windows freely (they can only mean
+        its one table); multi-source flows must prefix-match or set the
+        window's ``table`` conf key."""
+        best = ""
+        for t in targets:
+            if wname.startswith(t + "_") and len(t) > len(best):
+                best = t
+        if best:
+            return best
+        return targets[0] if len(targets) == 1 else ""
+
+    def _default_projection(self, schema: Schema) -> str:
         # the HomeAutomation normalization snippet shape
         # (gui.input.properties.normalizationSnippet)
         lines = ["Raw.*"]
-        if self.timestamp_column and not self.input_schema.has(self.timestamp_column):
+        if self.timestamp_column and not schema.has(self.timestamp_column):
             lines.insert(0, f"current_timestamp() AS {self.timestamp_column}")
         return "\n".join(lines)
 
@@ -242,50 +391,73 @@ class FlowProcessor:
         return parse_select("SELECT " + ", ".join(items) + f" FROM {from_table}")
 
     def _build_pipeline(self, output_datasets: Optional[List[str]]):
-        cap = self.batch_capacity
-        pc = PipelineCompiler(self.dictionary, self.udfs)
+        pc = PipelineCompiler(
+            self.dictionary, self.udfs, config=self.planner_config
+        )
         # one dictionary-table registry for the whole flow (projection +
         # transform share string-op tables; see compile/stringops.py);
         # the builder materializes them per batch for the jitted step
         self.aux_registry = pc.aux
 
-        # 1. projection pipeline: Raw -> DataXProcessedInput
+        # 1. per-source projection pipelines: Raw -> <target table>
         from ..compile.planner import SelectCompiler
 
-        proj_catalog = {"Raw": self.raw_schema, DatasetName.DataStreamRaw: self.raw_schema}
-        proj_caps = {"Raw": cap, DatasetName.DataStreamRaw: cap}
-        cur_name = "Raw"
-        self.projection_views = []
-        for i, step_text in enumerate(self.projection_steps):
-            sel = self._projection_select(step_text, cur_name)
-            compiler = SelectCompiler(
-                proj_catalog, proj_caps, self.dictionary, self.udfs,
-                aux=pc.aux,
-            )
-            vname = (
-                DatasetName.DataStreamProjection
-                if i == len(self.projection_steps) - 1
-                else f"__proj{i}"
-            )
-            view = compiler.compile_select(vname, sel)
-            self.projection_views.append(view)
-            proj_catalog[vname] = view.schema
-            proj_caps[vname] = view.capacity
-            cur_name = vname
-        self.projected_schema = proj_catalog[DatasetName.DataStreamProjection]
+        self.projection_views: Dict[str, List] = {}
+        self.target_schemas: Dict[str, ViewSchema] = {}
+        for spec in self.specs.values():
+            proj_catalog = {
+                "Raw": spec.raw_schema,
+                DatasetName.DataStreamRaw: spec.raw_schema,
+            }
+            proj_caps = {
+                "Raw": spec.capacity,
+                DatasetName.DataStreamRaw: spec.capacity,
+            }
+            cur_name = "Raw"
+            views = []
+            for i, step_text in enumerate(spec.projection_steps):
+                sel = self._projection_select(step_text, cur_name)
+                compiler = SelectCompiler(
+                    proj_catalog, proj_caps, self.dictionary, self.udfs,
+                    self.planner_config, aux=pc.aux,
+                )
+                vname = (
+                    spec.target
+                    if i == len(spec.projection_steps) - 1
+                    else f"__proj{i}"
+                )
+                view = compiler.compile_select(vname, sel)
+                views.append(view)
+                proj_catalog[vname] = view.schema
+                proj_caps[vname] = view.capacity
+                cur_name = vname
+            self.projection_views[spec.name] = views
+            self.target_schemas[spec.target] = proj_catalog[spec.target]
+        self.projected_schema = self.target_schemas[
+            self.specs[self.primary].target
+        ]
 
-        # 2. window slots
-        self.slots = 1
-        if self.windows:
-            max_w = max(self.windows.values())
-            self.slots = num_slots(max_w, self.watermark_s, self.interval_s)
+        # 2. window slots per windowed target table
+        self.ring_slots: Dict[str, int] = {}
+        for wname, (table, dur_s) in self.windows.items():
+            if self.timestamp_column not in self.target_schemas[table].types:
+                raise EngineException(
+                    f"timewindow {wname} requires timestamp column "
+                    f"{self.timestamp_column!r} in table {table}"
+                )
+            slots = num_slots(dur_s, self.watermark_s, self.interval_s)
+            self.ring_slots[table] = max(self.ring_slots.get(table, 1), slots)
 
         # 3. main pipeline inputs
+        target_caps = {s.target: s.capacity for s in self.specs.values()}
         inputs: Dict[str, Tuple[ViewSchema, int]] = {
-            DatasetName.DataStreamProjection: (self.projected_schema, cap),
+            t: (sch, target_caps[t]) for t, sch in self.target_schemas.items()
         }
-        for wname in self.windows:
-            inputs[wname] = (self.projected_schema, self.slots * cap)
+        for wname, (table, _dur) in self.windows.items():
+            inputs[wname] = (
+                self.target_schemas[table],
+                self.ring_slots[table] * target_caps[table],
+            )
         for rname, (rschema, rtable) in self.refdata.items():
             inputs[rname] = (rschema, rtable.capacity)
         state_inputs = {
@@ -336,11 +508,11 @@ class FlowProcessor:
         ]
 
     def _init_device_state(self):
-        cap = self.batch_capacity
         self.window_buffers: Dict[str, WindowBuffers] = {}
-        if self.windows:
-            self.window_buffers["__ring"] = make_buffers(
-                self.projected_schema, cap, self.slots
+        target_caps = {s.target: s.capacity for s in self.specs.values()}
+        for table, slots in self.ring_slots.items():
+            self.window_buffers[table] = make_buffers(
+                self.target_schemas[table], target_caps[table], slots
             )
         self.state_data: Dict[str, TableData] = {
             sname: st.load(self.dictionary) for sname, st in self.state_tables.items()
@@ -350,6 +522,63 @@ class FlowProcessor:
         # host-side ingest counters (e.g. rows dropped for garbage
         # timestamps), drained into metrics at each collect
         self.ingest_stats: Dict[str, int] = {}
+        self._native_decoders: Dict[str, object] = {}
+
+    # -- window-state checkpoint ------------------------------------------
+    def snapshot_window_state(self) -> Dict[str, object]:
+        """Host copy of everything a restart would otherwise lose: the
+        window ring buffers, the slot counter, and the time base the ring
+        timestamps are relative to. Numpy-only; feed to
+        ``WindowStateCheckpointer.save`` (reference restores window state
+        via the StreamingContext checkpoint, StreamingHost.scala:83-89)."""
+        rings = {}
+        for table, buf in self.window_buffers.items():
+            rings[table] = {
+                "cols": {c: np.asarray(a) for c, a in buf.cols.items()},
+                "valid": np.asarray(buf.valid),
+            }
+        return {
+            "rings": rings,
+            "slot_counter": self._slot_counter,
+            "base_ms": self._base_ms,
+        }
+
+    def restore_window_state(self, snap: Dict[str, object]) -> bool:
+        """Restore a ``snapshot_window_state`` result. Shape-checked: a
+        conf change that resized the rings invalidates the snapshot
+        (returns False and keeps the fresh zero state)."""
+        rings = snap.get("rings", {})
+        restored: Dict[str, WindowBuffers] = {}
+        for table, buf in self.window_buffers.items():
+            saved = rings.get(table)
+            if saved is None:
+                return False
+            if set(saved["cols"]) != set(buf.cols) or any(
+                saved["cols"][c].shape != buf.cols[c].shape
+                or saved["cols"][c].dtype != np.asarray(buf.cols[c]).dtype
+                for c in buf.cols
+            ):
+                return False
+            restored[table] = WindowBuffers(
+                {c: jnp.asarray(a) for c, a in saved["cols"].items()},
+                jnp.asarray(saved["valid"]),
+            )
+        if self.mesh is not None:
+            from ..dist.mesh import ring_sharding
+
+            sh = ring_sharding(self.mesh)
+            restored = {
+                t: WindowBuffers(
+                    {c: jax.device_put(a, sh) for c, a in b.cols.items()},
+                    jax.device_put(b.valid, sh),
+                )
+                for t, b in restored.items()
+            }
+        self.window_buffers = restored
+        self._slot_counter = int(snap.get("slot_counter", 0))
+        base = snap.get("base_ms")
+        self._base_ms = int(base) if base is not None else None
+        return True
 
     # -- the jitted step --------------------------------------------------
     def _jit_step(self):
@@ -358,39 +587,52 @@ class FlowProcessor:
         output_datasets = list(self.output_datasets)
         state_names = list(self.state_tables)
         pipeline = self.pipeline
-        proj_views = self.projection_views
+        specs = list(self.specs.values())
+        proj_views = dict(self.projection_views)
         refdata_names = list(self.refdata)
+        ring_tables = list(self.ring_slots)
+        primary_target = self.specs[self.primary].target
 
         def step(
-            raw: TableData,
-            ring: Optional[WindowBuffers],
+            raw: Dict[str, TableData],
+            rings: Dict[str, WindowBuffers],
             state: Dict[str, TableData],
             refdata: Dict[str, TableData],
             base_s: jnp.ndarray,
             now_rel_ms: jnp.ndarray,
-            slot: jnp.ndarray,
+            counter: jnp.ndarray,
             delta_ms: jnp.ndarray,
             aux: Dict[str, jnp.ndarray],
         ):
-            env: Dict[str, TableData] = {
-                "Raw": raw,
-                DatasetName.DataStreamRaw: raw,
-                "__aux": aux,
-            }
-            for v in proj_views:
-                env[v.name] = v.fn(env, base_s, now_rel_ms)
-            projected = env[DatasetName.DataStreamProjection]
+            # 1. per-source projection into its target table (each source
+            # gets its own env so `Raw` binds to ITS raw table)
+            projected: Dict[str, TableData] = {}
+            for spec in specs:
+                env: Dict[str, TableData] = {
+                    "Raw": raw[spec.name],
+                    DatasetName.DataStreamRaw: raw[spec.name],
+                    "__aux": aux,
+                }
+                for v in proj_views[spec.name]:
+                    env[v.name] = v.fn(env, base_s, now_rel_ms)
+                projected[spec.target] = env[spec.target]
 
-            new_ring = None
-            if ring is not None:
-                new_ring = update_buffers(ring, projected, slot, delta_ms, ts_col)
+            # 2. ring updates (one ring per windowed table; each ring's
+            # slot index derives from the shared batch counter)
+            new_rings: Dict[str, WindowBuffers] = {}
+            for table in ring_tables:
+                buf = rings[table]
+                slot = jax.lax.rem(
+                    counter, jnp.asarray(buf.valid.shape[0], jnp.int32)
+                )
+                new_rings[table] = update_buffers(
+                    buf, projected[table], slot, delta_ms, ts_col
+                )
 
-            tables: Dict[str, TableData] = {
-                DatasetName.DataStreamProjection: projected
-            }
-            for wname, dur_s in windows.items():
+            tables: Dict[str, TableData] = dict(projected)
+            for wname, (table, dur_s) in windows.items():
                 tables[wname] = window_table(
-                    new_ring, int(dur_s * 1000), now_rel_ms, ts_col
+                    new_rings[table], int(dur_s * 1000), now_rel_ms, ts_col
                 )
             for rname in refdata_names:
                 tables[rname] = refdata[rname]
@@ -409,7 +651,7 @@ class FlowProcessor:
             from ..ops.compact import compact_indices
 
             datasets = {}
-            counts = [projected.count()]
+            counts = [projected[primary_target].count()]
             for n in output_datasets:
                 t = out[n]
                 idx, ov = compact_indices(t.valid, t.valid.shape[0])
@@ -419,27 +661,31 @@ class FlowProcessor:
                     ov,
                 )
                 counts.append(t.count())
-            for n in output_datasets:
-                # fixed layout: one overflow slot per output; -1 marks
-                # "output does not track overflow" so the host can keep
-                # emitting GroupsDropped=0 for outputs that do
-                counts.append(
-                    out[n].cols["__overflow.groups"][0]
-                    if "__overflow.groups" in out[n].cols
-                    else jnp.asarray(-1, jnp.int32)
-                )
+            # fixed layout: per output one groups-overflow then one
+            # join-overflow slot; -1 marks "output does not track this
+            # overflow" so the host can keep emitting 0 for ones that do
+            for key in ("__overflow.groups", "__overflow.joins"):
+                for n in output_datasets:
+                    counts.append(
+                        out[n].cols[key][0]
+                        if key in out[n].cols
+                        else jnp.asarray(-1, jnp.int32)
+                    )
+            # per-target projected input counts (multi-source metrics)
+            for spec in specs:
+                counts.append(projected[spec.target].count())
             counts_vec = jnp.stack(
                 [jnp.asarray(c, jnp.int32) for c in counts]
             )
             # plain tuple of pytrees for the jit boundary
-            return (datasets, new_ring, new_state, counts_vec)
+            return (datasets, new_rings, new_state, counts_vec)
 
         self._step_fn = step
-        # donate the ring: the old buffer is dead after the step, so XLA
-        # updates the (large) window ring in place instead of allocating
-        # a copy each batch. State tables are NOT donated — a pipelined
-        # PendingBatch still reads its state for the A/B overwrite after
-        # the next batch has been dispatched.
+        # donate the rings: the old buffers are dead after the step, so
+        # XLA updates the (large) window rings in place instead of
+        # allocating copies each batch. State tables are NOT donated — a
+        # pipelined PendingBatch still reads its state for the A/B
+        # overwrite after the next batch has been dispatched.
         if self.mesh is not None:
             from ..dist.mesh import step_shardings
 
@@ -454,28 +700,36 @@ class FlowProcessor:
             self._step = jax.jit(step, donate_argnums=(1,))
 
     # -- per-batch host path ----------------------------------------------
-    def encode_rows(self, rows: List[dict], base_ms: int) -> TableData:
+    def _spec(self, source: Optional[str]) -> SourceSpec:
+        return self.specs[source or self.primary]
+
+    def encode_rows(
+        self, rows: List[dict], base_ms: int, source: Optional[str] = None
+    ) -> TableData:
         """Host-side fallback encoder (python loop). The C++ decoder in
         native/ covers the hot path; benchmarks use the vectorized
         generator."""
         from ..core.batch import batch_from_rows
 
+        spec = self._spec(source)
         b = batch_from_rows(
-            rows, self.input_schema, self.batch_capacity, self.dictionary,
+            rows, spec.schema, spec.capacity, self.dictionary,
             base_ms, stats=self.ingest_stats,
         )
         cols = dict(b.columns)
         cols.setdefault(
             ColumnName.RawPropertiesColumn,
-            jnp.zeros((self.batch_capacity,), jnp.int32),
+            jnp.zeros((spec.capacity,), jnp.int32),
         )
         cols.setdefault(
             ColumnName.RawSystemPropertiesColumn,
-            jnp.zeros((self.batch_capacity,), jnp.int32),
+            jnp.zeros((spec.capacity,), jnp.int32),
         )
         return TableData(cols, b.valid)
 
-    def encode_json_bytes(self, data: bytes, base_ms: int) -> TableData:
+    def encode_json_bytes(
+        self, data: bytes, base_ms: int, source: Optional[str] = None
+    ) -> TableData:
         """Native ingest hot path: newline-delimited JSON bytes decoded by
         the C++ decoder (native/decoder.cpp) straight into columnar
         buffers — the from_json role at CommonProcessorFactory.scala:90-103
@@ -483,6 +737,7 @@ class FlowProcessor:
         row encoder if the native library is unavailable."""
         from ..native import native_available
 
+        spec = self._spec(source)
         if not native_available():
             import json as _json
 
@@ -494,25 +749,25 @@ class FlowProcessor:
                     rows.append(_json.loads(ln))
                 except ValueError:
                     continue  # skip malformed lines like the native path
-                if len(rows) >= self.batch_capacity:
+                if len(rows) >= spec.capacity:
                     break
-            return self.encode_rows(rows, base_ms)
+            return self.encode_rows(rows, base_ms, source=spec.name)
 
-        if not hasattr(self, "_native_decoder") or self._native_decoder is None:
+        decoder = self._native_decoders.get(spec.name)
+        if decoder is None:
             from ..native import NativeDecoder
 
-            self._native_decoder = NativeDecoder(self.input_schema, self.dictionary)
-        arrays, valid, rows, _consumed = self._native_decoder.decode(
-            data, self.batch_capacity
-        )
-        if self._native_decoder.last_bad_timestamps:
+            decoder = NativeDecoder(spec.schema, self.dictionary)
+            self._native_decoders[spec.name] = decoder
+        arrays, valid, rows, _consumed = decoder.decode(data, spec.capacity)
+        if decoder.last_bad_timestamps:
             self.ingest_stats["bad_timestamps"] = (
                 self.ingest_stats.get("bad_timestamps", 0)
-                + self._native_decoder.last_bad_timestamps
+                + decoder.last_bad_timestamps
             )
-        cap = self.batch_capacity
+        cap = spec.capacity
         cols: Dict[str, jnp.ndarray] = {}
-        for col in self.input_schema.columns:
+        for col in spec.schema.columns:
             a = arrays[col.name]
             if col.ctype == ColType.TIMESTAMP:
                 # slots the decoder left at 0 (field missing) stay at
@@ -530,29 +785,43 @@ class FlowProcessor:
             ColumnName.RawPropertiesColumn,
             ColumnName.RawSystemPropertiesColumn,
         ):
-            if extra in self.raw_schema.types and extra not in cols:
+            if extra in spec.raw_schema.types and extra not in cols:
                 cols[extra] = jnp.zeros((cap,), jnp.int32)
         return TableData(cols, jnp.asarray(valid))
 
-    def encode_columns(self, np_cols: Dict[str, np.ndarray], n: int) -> TableData:
-        cap = self.batch_capacity
+    def encode_columns(
+        self, np_cols: Dict[str, np.ndarray], n: int,
+        source: Optional[str] = None,
+    ) -> TableData:
+        spec = self._spec(source)
+        cap = spec.capacity
+        fill_dtype = {"double": jnp.float32, "boolean": jnp.bool_}
         cols = {}
-        for c in self.raw_schema.types:
+        for c, t in spec.raw_schema.types.items():
             if c in np_cols:
                 a = np_cols[c]
                 pad = np.zeros(cap, dtype=a.dtype)
                 pad[: min(n, cap)] = a[: min(n, cap)]
                 cols[c] = jnp.asarray(pad)
             else:
-                cols[c] = jnp.zeros((cap,), jnp.int32)
+                cols[c] = jnp.zeros((cap,), fill_dtype.get(t, jnp.int32))
         valid = np.zeros(cap, dtype=bool)
         valid[: min(n, cap)] = True
         return TableData(cols, jnp.asarray(valid))
 
+    def _empty_raw(self, spec: SourceSpec) -> TableData:
+        return self.encode_columns({}, 0, source=spec.name)
+
     def dispatch_batch(
-        self, raw: TableData, batch_time_ms: Optional[int] = None
+        self,
+        raw: Union[TableData, Dict[str, TableData]],
+        batch_time_ms: Optional[int] = None,
     ) -> "PendingBatch":
         """Queue one micro-batch on the device and return a handle.
+
+        ``raw``: one TableData (routed to the primary source) or a dict
+        {source name -> TableData}; sources absent from the dict run with
+        an empty batch, so independent streams may tick at their own pace.
 
         The device runs asynchronously: the caller can encode/dispatch
         the next batch (or run sinks for the previous one) while this
@@ -563,6 +832,18 @@ class FlowProcessor:
         t0 = time.time()
         if batch_time_ms is None:
             batch_time_ms = int(time.time() * 1000)
+        if isinstance(raw, TableData):
+            raw = {self.primary: raw}
+        for name in raw:
+            if name not in self.specs:
+                raise EngineException(
+                    f"dispatch_batch got unknown source {name!r} "
+                    f"(declared: {list(self.specs)})"
+                )
+        raw = {
+            name: raw.get(name) or self._empty_raw(spec)
+            for name, spec in self.specs.items()
+        }
         # per-interval UDF refresh hooks; state changes re-trace the step
         # (CommonProcessorFactory.scala:351-353 onInterval invocation)
         from ..udf import UdfRegistry
@@ -575,33 +856,44 @@ class FlowProcessor:
         if self._base_ms is None:
             self._base_ms = new_base_ms
         delta_ms = new_base_ms - self._base_ms
+        if abs(delta_ms) > 2**31 - 1:
+            # a restored checkpoint (or clock jump) more than ~24.8 days
+            # out: every ring row is long past any window horizon, and
+            # the int32 rebase would overflow — start from clean rings
+            target_caps = {s.target: s.capacity for s in self.specs.values()}
+            self.window_buffers = {
+                table: make_buffers(
+                    self.target_schemas[table], target_caps[table], slots
+                )
+                for table, slots in self.ring_slots.items()
+            }
+            delta_ms = 0
         self._base_ms = new_base_ms
 
         base_s = jnp.asarray(new_base_ms // 1000, jnp.int32)
         now_rel_ms = jnp.asarray(batch_time_ms - new_base_ms, jnp.int32)
-        slot = jnp.asarray(self._slot_counter % self.slots, jnp.int32)
+        counter = jnp.asarray(self._slot_counter, jnp.int32)
         self._slot_counter += 1
 
-        ring = self.window_buffers.get("__ring")
         refdata_tables = {n: t for n, (_, t) in self.refdata.items()}
         # string-op dictionary tables: refreshed AFTER this batch's encode
         # (so they cover every id the batch can contain), cached until the
         # dictionary grows; growth past table capacity retraces the step
         aux = self.aux_tables.tables()
-        out_datasets, new_ring, new_state, counts_vec = self._step(
-            raw, ring, self.state_data, refdata_tables,
-            base_s, now_rel_ms, slot, jnp.asarray(delta_ms, jnp.int32),
+        out_datasets, new_rings, new_state, counts_vec = self._step(
+            raw, self.window_buffers, self.state_data, refdata_tables,
+            base_s, now_rel_ms, counter, jnp.asarray(delta_ms, jnp.int32),
             aux,
         )
         # carry device state forward without materializing — the next
         # dispatch may consume these handles before this batch collects
-        if new_ring is not None:
-            self.window_buffers["__ring"] = new_ring
+        self.window_buffers = new_rings
         self.state_data = new_state
         handle = PendingBatch(
             self, self.pipeline, out_datasets, new_state, counts_vec,
             batch_time_ms, new_base_ms, t0,
             out_names=list(self.output_datasets),
+            target_names=[s.target for s in self.specs.values()],
         )
         # begin the device->host result copies NOW (async enqueue, free):
         # by the time collect() runs — typically one pipelined iteration
@@ -612,7 +904,9 @@ class FlowProcessor:
         return handle
 
     def process_batch(
-        self, raw: TableData, batch_time_ms: Optional[int] = None
+        self,
+        raw: Union[TableData, Dict[str, TableData]],
+        batch_time_ms: Optional[int] = None,
     ) -> Tuple[Dict[str, List[dict]], Dict[str, float]]:
         """Run one micro-batch; returns (materialized datasets, metrics).
 
@@ -641,6 +935,7 @@ class PendingBatch:
         self, proc: "FlowProcessor", pipeline, out_datasets, state,
         counts_vec, batch_time_ms: int, base_ms: int, t0: float,
         out_names: Optional[List[str]] = None,
+        target_names: Optional[List[str]] = None,
     ):
         self.proc = proc
         # THIS batch's pipeline: a UDF onInterval refresh may rebuild
@@ -652,6 +947,10 @@ class PendingBatch:
         self.out_names = (
             list(out_names) if out_names is not None
             else list(proc.output_datasets)
+        )
+        self.target_names = (
+            list(target_names) if target_names is not None
+            else [s.target for s in proc.specs.values()]
         )
         self.out_datasets = out_datasets
         self.state = state  # THIS batch's state, for the A/B overwrite
@@ -693,9 +992,10 @@ class PendingBatch:
         ``dispatch_batch``) every read below hits an already-landed host
         copy. Otherwise: ONE host sync for every per-batch scalar
         (layout: input count, per-output counts, per-output overflow
-        slots), then the device-compacted outputs are sliced to their
-        true row counts so only real rows cross the device->host
-        boundary, fetched in one batched device_get.
+        slots for groups then joins, per-source projected counts), then
+        the device-compacted outputs are sliced to their true row counts
+        so only real rows cross the device->host boundary, fetched in
+        one batched device_get.
         """
         proc = self.proc
         if self._prefetched or proc.batch_capacity <= SMALL_FETCH_ROWS:
@@ -708,11 +1008,11 @@ class PendingBatch:
         else:
             counts = np.asarray(self.counts_vec)
             host_full = None
-        input_count = int(counts[0])
         # unpack in PACKING order (snapshotted at dispatch) — jax returns
         # dict pytrees with sorted keys, so iterating out_datasets may
         # not match the order the step packed counts in
         names = self.out_names
+        tnames = self.target_names
         dataset_counts = {
             n: int(counts[1 + i]) for i, n in enumerate(names)
         }
@@ -720,6 +1020,15 @@ class PendingBatch:
             n: int(counts[1 + len(names) + i])
             for i, n in enumerate(names)
             if int(counts[1 + len(names) + i]) >= 0
+        }
+        dropped_joins = {
+            n: int(counts[1 + 2 * len(names) + i])
+            for i, n in enumerate(names)
+            if int(counts[1 + 2 * len(names) + i]) >= 0
+        }
+        target_counts = {
+            t: int(counts[1 + 3 * len(names) + i])
+            for i, t in enumerate(tnames)
         }
         source_tables = (
             host_full if host_full is not None else self.out_datasets
@@ -751,16 +1060,17 @@ class PendingBatch:
 
         elapsed_ms = (time.time() - self.t0) * 1000.0
         metrics = {
-            f"Input_{DatasetName.DataStreamProjection}_Events_Count": float(
-                input_count
-            ),
             "Latency-Process": elapsed_ms,
             "BatchProcessedET": float(self.batch_time_ms),
         }
+        for t, c in target_counts.items():
+            metrics[f"Input_{t}_Events_Count"] = float(c)
         for n, c in dataset_counts.items():
             metrics[f"Output_{n}_Events_Count"] = float(c)
         for n, c in dropped_groups.items():
             metrics[f"Output_{n}_GroupsDropped"] = float(c)
+        for n, c in dropped_joins.items():
+            metrics[f"Output_{n}_JoinRowsDropped"] = float(c)
         # drain host-side ingest counters accumulated since last collect
         if proc.ingest_stats:
             for k, v in proc.ingest_stats.items():

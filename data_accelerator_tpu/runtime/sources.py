@@ -646,21 +646,31 @@ class KafkaSource(StreamingSource):
             pass
 
 
-def make_source(conf, schema: Schema) -> StreamingSource:
-    """Build the source declared by ``datax.job.input.default.*`` conf.
+def make_source(conf, schema: Schema, source: str = "default") -> StreamingSource:
+    """Build the source declared by ``datax.job.input.default.*`` (or one
+    ``input.sources.<name>.*`` entry, passed as ``source``) conf.
 
     reference: the per-mode app entry points (DirectStreamingApp etc.)
     pick the input factory; here one factory keys off ``inputtype``.
+
+    Each named source gets its own offset-ledger name (prefixed with the
+    source name for non-default sources) so a multi-source flow's
+    checkpoints never collide; the default source keeps the legacy names
+    so existing single-source checkpoints stay readable.
     """
     input_type = (conf.get("inputtype") or "local").lower()
+
+    def nm(base: str) -> str:
+        return base if source == "default" else f"{source}.{base}"
+
     if input_type == "local":
-        return LocalSource(schema)
+        return LocalSource(schema, name=nm("local"))
     if input_type in ("file", "blob"):
         patterns = (conf.get("blobpathregex") or conf.get("path") or "").split(";")
-        return FileSource([p for p in patterns if p])
+        return FileSource([p for p in patterns if p], name=nm("files"))
     if input_type == "socket":
         port = conf.get_int_option("socket.port") or 0
-        return SocketSource(port=port)
+        return SocketSource(port=port, name=nm("socket"))
     if input_type == "kafka":
         topics = (conf.get("kafka.topics") or "").split(";")
         return KafkaSource(
@@ -672,9 +682,12 @@ def make_source(conf, schema: Schema) -> StreamingSource:
         # pointer events arrive over socket or from a pointer file
         pointer_path = conf.get("pointerfile")
         inner: StreamingSource = (
-            FileSource([pointer_path], name="pointers")
+            FileSource([pointer_path], name=nm("pointers"))
             if pointer_path
-            else SocketSource(port=conf.get_int_option("socket.port") or 0)
+            else SocketSource(
+                port=conf.get_int_option("socket.port") or 0,
+                name=nm("socket"),
+            )
         )
         sources = {
             sid: sub.get_or_else("target", sid)

@@ -135,7 +135,7 @@ def test_sharded_input_placement(tmp_path):
         jax.device_put(jnp.asarray(valid), sh),
     )
     proc.process_batch(raw, batch_time_ms=1_700_000_000_000)
-    ring = proc.window_buffers["__ring"]
+    ring = proc.window_buffers["DataXProcessedInput"]
     ts = ring.cols[proc.timestamp_column]
     assert len(ts.sharding.device_set) == 8
 

@@ -98,7 +98,7 @@ def test_inner_join_basic():
     rk = jnp.array([2, 3, 2], dtype=jnp.int32)
     lv = jnp.ones(4, dtype=bool)
     rv = jnp.array([1, 1, 1], dtype=bool)
-    li, ri, valid = inner_join_indices([lk], [rk], lv, rv, out_capacity=8)
+    li, ri, valid, dropped = inner_join_indices([lk], [rk], lv, rv, out_capacity=8)
     pairs = {
         (int(lk[li[i]]), int(rk[ri[i]]))
         for i in range(8)
@@ -116,7 +116,7 @@ def test_inner_join_residual_condition():
     rval = jnp.array([15, 25], dtype=jnp.int32)
     lv = jnp.ones(2, dtype=bool)
     rv = jnp.ones(2, dtype=bool)
-    li, ri, valid = inner_join_indices(
+    li, ri, valid, _dropped = inner_join_indices(
         [lk], [rk], lv, rv, 8,
         residual=lambda i, j: lval[i] > rval[j],
     )
@@ -129,8 +129,9 @@ def test_join_overflow_drops():
     rk = jnp.zeros(4, dtype=jnp.int32)
     lv = jnp.ones(4, dtype=bool)
     rv = jnp.ones(4, dtype=bool)
-    _, _, valid = inner_join_indices([lk], [rk], lv, rv, out_capacity=5)
+    _, _, valid, dropped = inner_join_indices([lk], [rk], lv, rv, out_capacity=5)
     assert int(np.asarray(valid).sum()) == 5  # 16 matches capped at 5
+    assert int(dropped) == 11  # and the overflow is counted, not silent
 
 
 def test_left_join_unmatched():
@@ -138,7 +139,7 @@ def test_left_join_unmatched():
     rk = jnp.array([2], dtype=jnp.int32)
     lv = jnp.ones(2, dtype=bool)
     rv = jnp.ones(1, dtype=bool)
-    li, ri, valid, is_null = left_join_indices([lk], [rk], lv, rv, 4)
+    li, ri, valid, is_null, dropped = left_join_indices([lk], [rk], lv, rv, 4)
     rows = [
         (int(lk[li[i]]), bool(is_null[i]))
         for i in range(4)
