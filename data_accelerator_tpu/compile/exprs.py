@@ -148,6 +148,8 @@ class Scope:
             col = self._match_in(binding, rest)
             if col is not None:
                 return binding, col
+            if rest in self.deferred.get(binding, {}):
+                return binding, rest
             # fall through: maybe "deviceDetails.deviceId" where
             # deviceDetails coincides with nothing
         candidates: List[Tuple[str, str]] = []
@@ -155,6 +157,10 @@ class Scope:
             col = self._match_in(binding, dotted)
             if col is not None:
                 candidates.append((binding, col))
+        # deferred (computed-string) columns resolve by exact name
+        for binding, dcols in self.deferred.items():
+            if dotted in dcols:
+                candidates.append((binding, dotted))
         if len(candidates) == 1:
             return candidates[0]
         if len(candidates) > 1:
@@ -326,15 +332,26 @@ class ExprCompiler:
                 deps=l.deps + r.deps,
             )
 
-        l = self._as_device(e.left)
-        r = self._as_device(e.right)
+        lv = self.compile(e.left)
+        rv = self.compile(e.right)
+        if op in ("=", "!=") and (
+            isinstance(lv, HostStr) or isinstance(rv, HostStr)
+        ):
+            # computed strings (CONCAT/CAST results) compare via the
+            # device hash tier instead of dictionary ids
+            return self._deferred_equality(op, lv, rv, e)
+
+        l = self._as_device_value(lv, e.left)
+        r = self._as_device_value(rv, e.right)
 
         if op in ("=", "!=", "<", "<=", ">", ">="):
             return self._comparison(op, l, r)
         return self._arith(op, l, r)
 
     def _as_device(self, e: Expr) -> CompiledExpr:
-        v = self.compile(e)
+        return self._as_device_value(self.compile(e), e)
+
+    def _as_device_value(self, v: Value, e: Expr) -> CompiledExpr:
         if isinstance(v, HostStr):
             raise EngineException(
                 "deferred string expressions (CONCAT/CAST-to-string results) "
@@ -343,6 +360,117 @@ class ExprCompiler:
         if not is_device(v):
             raise EngineException(f"composite value not usable here: {e!r}")
         return v
+
+    # -- computed-string device keys --------------------------------------
+    def hash_keys(self, v: Value) -> Optional[List[CompiledExpr]]:
+        """Device key triple ``[h1, h2, isnull]`` for a string value.
+
+        Gives deferred strings (CONCAT/CAST-to-string results) a
+        first-class device tier for equality / GROUP BY / JOIN: two
+        independent rolling hashes compose over concatenation via the
+        per-id hash/p^len tables (see stringops.register_strhash), so a
+        computed string never needs a dictionary id to participate in
+        device comparisons. Returns None when ``v`` is not a string or
+        contains non-string device parts (CAST(<numeric> AS STRING) has
+        unbounded value space — no table can cover it).
+
+        reference parity: the reference composes string expressions
+        freely because Spark SQL evaluates them row-by-row
+        (CommonProcessorFactory.scala:257); this is the TPU-resident
+        equivalent for the equality-class uses.
+        """
+        from .stringops import (
+            HASH1_KEY,
+            HASH2_KEY,
+            HASH_P1,
+            HASH_P2,
+            PLEN1_KEY,
+            PLEN2_KEY,
+            poly_hash,
+            pow_len,
+            register_strhash,
+        )
+
+        if is_device(v) and v.type == "string":
+            parts: List[Union[str, CompiledExpr]] = [v]
+        elif isinstance(v, HostStr):
+            parts = []
+            for p in v.parts:
+                if isinstance(p, str):
+                    parts.append(p)
+                elif is_device(p) and p.type == "string":
+                    parts.append(p)
+                else:
+                    return None
+        else:
+            return None
+        register_strhash(self.aux)
+        deps = tuple(
+            d
+            for p in parts
+            if not isinstance(p, str)
+            for d in p.deps
+        )
+
+        def null_of(env, parts=parts):
+            n = jnp.broadcast_to(jnp.asarray(False), env.shape)
+            for p in parts:
+                if not isinstance(p, str):
+                    n = n | (p.fn(env) == 0)
+            return n
+
+        def make(hkey, pkey, hp):
+            consts = [
+                (poly_hash(p, hp), pow_len(p, hp))
+                if isinstance(p, str) else None
+                for p in parts
+            ]
+
+            def run(env, parts=parts, consts=consts, hkey=hkey, pkey=pkey):
+                th = env.scopes["__aux"][hkey]
+                tq = env.scopes["__aux"][pkey]
+                h_acc = jnp.zeros(env.shape, jnp.int32)
+                for p, c in zip(parts, consts):
+                    if c is not None:
+                        # H(a+lit) = H(a)*p^len(lit) + H(lit), int32 wrap
+                        h_acc = h_acc * jnp.asarray(c[1], jnp.int32) \
+                            + jnp.asarray(c[0], jnp.int32)
+                    else:
+                        idx = jnp.clip(p.fn(env), 0, th.shape[0] - 1)
+                        h_acc = h_acc * tq[idx] + th[idx]
+                # a NULL part nulls the whole string; zero the hash so
+                # every null row carries the same key (SQL groups NULLs
+                # together)
+                return jnp.where(null_of(env), 0, h_acc)
+
+            return CompiledExpr("long", run, deps=deps)
+
+        return [
+            make(HASH1_KEY, PLEN1_KEY, HASH_P1),
+            make(HASH2_KEY, PLEN2_KEY, HASH_P2),
+            CompiledExpr("boolean", null_of, deps=deps),
+        ]
+
+    def _deferred_equality(self, op: str, lv: Value, rv: Value, e) -> CompiledExpr:
+        lk = self.hash_keys(lv)
+        rk = self.hash_keys(rv)
+        if lk is None or rk is None:
+            raise EngineException(
+                "string comparison with a computed string requires both "
+                "sides to be strings built from string columns/literals; "
+                f"CAST of numeric values to string cannot compare on device: {e!r}"
+            )
+        h1l, h2l, nl = lk
+        h1r, h2r, nr = rk
+
+        def run(env):
+            eq = (h1l.fn(env) == h1r.fn(env)) & (h2l.fn(env) == h2r.fn(env))
+            notnull = jnp.logical_not(nl.fn(env)) & jnp.logical_not(nr.fn(env))
+            if op == "=":
+                return eq & notnull
+            return jnp.logical_not(eq) & notnull
+
+        return CompiledExpr("boolean", run, deps=h1l.deps + h1r.deps)
 
     def _comparison(self, op: str, l: CompiledExpr, r: CompiledExpr) -> CompiledExpr:
         lt, rt = l.type, r.type

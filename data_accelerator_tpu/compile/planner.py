@@ -631,13 +631,37 @@ class SelectCompiler:
             raise EngineException(
                 "JOIN requires at least one equality between the two tables"
             )
-        compiled_pairs = [
-            (
-                self._expr_compiler(lscope).compile_device(le),
-                self._expr_compiler(rscope).compile_device(re_),
-            )
-            for le, re_ in eq_pairs
-        ]
+        compiled_pairs = []
+        for le, re_ in eq_pairs:
+            lc = self._expr_compiler(lscope)
+            rc = self._expr_compiler(rscope)
+            lv = lc.compile(le)
+            rv = rc.compile(re_)
+            if isinstance(lv, HostStr) or isinstance(rv, HostStr):
+                # computed-string join key: equate the device hash pair;
+                # a third pair tags NULLs differently per side so a NULL
+                # key never matches anything (SQL join semantics)
+                lk = lc.hash_keys(lv)
+                rk = rc.hash_keys(rv)
+                if lk is None or rk is None:
+                    raise EngineException(
+                        "JOIN on a computed string requires both sides "
+                        "built from string columns/literals: "
+                        f"{le!r} = {re_!r}"
+                    )
+                compiled_pairs.append((lk[0], rk[0]))
+                compiled_pairs.append((lk[1], rk[1]))
+                compiled_pairs.append(
+                    (_null_tag(lk[2], 1), _null_tag(rk[2], 2))
+                )
+            else:
+                for v, side in ((lv, "left"), (rv, "right")):
+                    if not is_device(v):
+                        raise EngineException(
+                            f"JOIN {side} key must be device-computable: "
+                            f"{le!r} = {re_!r}"
+                        )
+                compiled_pairs.append((lv, rv))
         residual = None
         if residual_parts:
             expr = residual_parts[0]
@@ -1032,9 +1056,18 @@ class SelectCompiler:
         for g in key_exprs:
             v = plain.compile(g)
             if isinstance(v, HostStr):
-                key_compiled.extend(
-                    p for p in v.parts if isinstance(p, CompiledExpr)
-                )
+                # computed string key: group by its device hash triple
+                # (exact string-equality classes); when the deferred
+                # expression embeds non-string parts (CAST of numerics),
+                # fall back to grouping by the part tuple — a refinement
+                # of string equality (may split "a"+"bc" from "ab"+"c")
+                hk = plain.hash_keys(v)
+                if hk is not None:
+                    key_compiled.extend(hk)
+                else:
+                    key_compiled.extend(
+                        p for p in v.parts if isinstance(p, CompiledExpr)
+                    )
             elif is_device(v):
                 key_compiled.append(v)
             else:
@@ -1173,6 +1206,16 @@ class SelectCompiler:
 
         schema = ViewSchema(out_types, deferred)
         return CompiledView(name, schema, capacity, run)
+
+
+def _null_tag(null_expr: CompiledExpr, tag: int) -> CompiledExpr:
+    """0 for non-null rows, a per-side tag for null rows — joined as an
+    extra equality key so null never equals null across sides."""
+
+    def run(env, n=null_expr, tag=tag):
+        return jnp.where(n.fn(env), jnp.int32(tag), jnp.int32(0))
+
+    return CompiledExpr("long", run, deps=null_expr.deps)
 
 
 def _distinct_count(vals, order, seg, valid_s, capacity):

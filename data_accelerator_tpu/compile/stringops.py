@@ -50,6 +50,52 @@ _KIND_DTYPE = {
 RANK_KEY = "__rank"
 UNRANK_KEY = "__unrank"
 
+# keys reserved for the computed-string hash tables. A deferred string
+# (CONCAT/CAST result) has no dictionary id, but equality/grouping/joins
+# only need a device value that discriminates strings: a polynomial
+# rolling hash composes over concatenation —
+#   H_p(a + b) = H_p(a) * p^len(b) + H_p(b)   (mod 2^32)
+# so per-id tables of H_p(s) and p^len(s) let the device compute the
+# hash of any concatenation with one multiply-add per part. TWO
+# independent hashes (different odd multipliers) are compared together,
+# making an accidental collision a ~2^-64 event — the practical price of
+# keeping computed strings fully device-resident (the dictionary stays
+# exact for plain string columns).
+HASH1_KEY = "__strhash1"
+HASH2_KEY = "__strhash2"
+PLEN1_KEY = "__strplen1"
+PLEN2_KEY = "__strplen2"
+HASH_P1 = 1000003
+HASH_P2 = 92821
+
+_MASK32 = (1 << 32) - 1
+
+
+def _wrap_i32(v: int) -> int:
+    """uint32 bits as the int32 value numpy will accept (device integer
+    arithmetic wraps, so int32 bit patterns compose identically)."""
+    v &= _MASK32
+    return v - (1 << 32) if v >= (1 << 31) else v
+
+
+def poly_hash(s: str, p: int) -> int:
+    h = 0
+    for ch in s:
+        h = (h * p + ord(ch) + 1) & _MASK32
+    return _wrap_i32(h)
+
+
+def pow_len(s: str, p: int) -> int:
+    return _wrap_i32(pow(p, len(s), 1 << 32))
+
+
+def register_strhash(registry: "AuxRegistry") -> None:
+    """Register the four computed-string hash tables."""
+    registry.register(HASH1_KEY, "scalar", lambda s: poly_hash(s, HASH_P1))
+    registry.register(HASH2_KEY, "scalar", lambda s: poly_hash(s, HASH_P2))
+    registry.register(PLEN1_KEY, "scalar", lambda s: pow_len(s, HASH_P1))
+    registry.register(PLEN2_KEY, "scalar", lambda s: pow_len(s, HASH_P2))
+
 # default bound on image-cascade rounds when building map tables:
 # functions whose results are new strings (which then need their own
 # mapping, e.g. REPLACE(REPLACE(x))) converge within a couple of rounds

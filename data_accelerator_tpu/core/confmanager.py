@@ -97,8 +97,20 @@ class ConfigManager:
             raise EngineException(
                 "non-conf file is not supported as configuration input"
             )
-        with open(path, "r", encoding="utf-8") as f:
-            props = parse_conf_lines(f.readlines(), d.dict)
+        if path.startswith("objstore://"):
+            # conf generated into the shared object store by the control
+            # plane (serve/storage.py ObjectRuntimeStorage) — workers on
+            # any host read it through the store, the role wasbs:// blob
+            # paths play for the reference's cluster jobs
+            from ..serve.objectstore import fetch_objstore_url
+
+            text = fetch_objstore_url(
+                path, token=os.environ.get("DATAX_OBJSTORE_TOKEN")
+            )
+            props = parse_conf_lines(text.splitlines(True), d.dict)
+        else:
+            with open(path, "r", encoding="utf-8") as f:
+                props = parse_conf_lines(f.readlines(), d.dict)
         merged = d.with_settings(props)
         cls.set_active_dictionary(merged)
         return merged

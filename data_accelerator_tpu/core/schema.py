@@ -152,6 +152,11 @@ class Schema:
         }
 
 
+class DictionaryFullError(RuntimeError):
+    """Raised in strict mode when the string dictionary hits its
+    configured capacity bound."""
+
+
 class StringDictionary:
     """Host-side bidirectional string<->int32 id dictionary.
 
@@ -163,9 +168,20 @@ class StringDictionary:
 
     NULL_ID = 0
 
-    def __init__(self):
+    def __init__(self, max_size: Optional[int] = None, strict: bool = False):
         self._to_id: Dict[str, int] = {}
         self._to_str: List[Optional[str]] = [None]  # id 0 -> null
+        # optional capacity bound (conf process.stringdictionary.maxsize):
+        # a hostile/high-cardinality stream would otherwise grow the
+        # dictionary — and every device lookup table derived from it —
+        # without limit. Beyond the bound new strings encode to NULL and
+        # are counted (overflow_count -> an ingest metric), or raise in
+        # strict mode. Existing ids are never evicted: device state
+        # (rings, state tables) holds ids across batches, so eviction
+        # would corrupt history.
+        self.max_size = max_size
+        self.strict = strict
+        self.overflow_count = 0
 
     def __len__(self) -> int:
         return len(self._to_str)
@@ -175,10 +191,38 @@ class StringDictionary:
             return self.NULL_ID
         i = self._to_id.get(s)
         if i is None:
+            if self.max_size is not None and len(self._to_str) >= self.max_size:
+                if self.strict:
+                    raise DictionaryFullError(
+                        f"string dictionary reached its configured bound "
+                        f"({self.max_size}); new string {s!r} rejected "
+                        "(datax.job.process.stringdictionary.strict=true)"
+                    )
+                self.overflow_count += 1
+                return self.NULL_ID
             i = len(self._to_str)
             self._to_str.append(s)
             self._to_id[s] = i
         return i
+
+    def entries(self) -> List[str]:
+        """Every non-null entry in id order (id 1 first) — the snapshot
+        a checkpoint persists so device-resident ids survive restarts."""
+        return list(self._to_str[1:])
+
+    def restore_entries(self, saved: List[str]) -> bool:
+        """Replay a saved ``entries()`` list into this dictionary.
+
+        The current contents (strings encoded during flow compile) must
+        be a prefix of the saved list — same conf produces the same
+        compile-time encodes in the same order — otherwise the saved ids
+        would alias different strings and the restore is refused."""
+        current = self._to_str[1:]
+        if current != saved[: len(current)]:
+            return False
+        for s in saved[len(current):]:
+            self.encode(s)
+        return True
 
     def lookup(self, s: Optional[str]) -> int:
         """Encode without inserting; unseen strings get -1 (matches nothing)."""

@@ -149,6 +149,14 @@ class WindowStateCheckpointer:
         arrays["base_ms"] = np.asarray(
             -1 if base is None else int(base), np.int64
         )
+        if snap.get("dictionary") is not None:
+            # ring ids are meaningless without the dictionary that
+            # encoded them; ride it along as JSON bytes
+            import json as _json
+
+            arrays["dictionary_json"] = np.frombuffer(
+                _json.dumps(snap["dictionary"]).encode("utf-8"), dtype=np.uint8
+            )
         if os.path.exists(self.path):
             shutil.copyfile(self.path, self.backup_path)
         tmp = self.path + ".tmp"
@@ -179,11 +187,18 @@ class WindowStateCheckpointer:
                         else:
                             ring["cols"][kind.split("/", 1)[1]] = z[key]
                     base = int(z["base_ms"])
-                    return {
+                    out = {
                         "rings": rings,
                         "slot_counter": int(z["slot_counter"]),
                         "base_ms": None if base < 0 else base,
                     }
+                    if "dictionary_json" in z.files:
+                        import json as _json
+
+                        out["dictionary"] = _json.loads(
+                            z["dictionary_json"].tobytes().decode("utf-8")
+                        )
+                    return out
             except Exception:
                 continue
         return None

@@ -68,12 +68,18 @@ def schema_to_view(schema: Schema) -> ViewSchema:
 
 def _read_maybe_file(value: str) -> str:
     """Conf values may inline content or point at a file (the reference
-    always loads from storage; one-box flows inline the schema JSON)."""
+    always loads from storage; one-box flows inline the schema JSON).
+    ``objstore://`` URLs fetch from the shared object store — the path
+    shape a control plane on another host generates."""
     if value is None:
         return None
     v = value.strip()
     if v.startswith("{") or v.startswith("[") or "\n" in v or "--" in v[:4]:
         return value
+    if v.startswith("objstore://"):
+        from ..utils.fs import read_text
+
+        return read_text(v)
     if os.path.exists(v):
         with open(v, "r", encoding="utf-8") as f:
             return f.read()
@@ -142,6 +148,67 @@ def _infer_csv_type(vals: List[str]) -> str:
         return "string"
 
 
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class PackedRaw:
+    """One-matrix host->device transfer of a raw batch.
+
+    On split hosts (TPU behind a network tunnel) each host->device array
+    costs a transfer op; a 7-column batch pays 7. Packing every 4-byte
+    column into rows of ONE [n_cols+1, capacity] int32 matrix (floats
+    bitcast, bools widened, validity as the last row) makes ingest a
+    single contiguous transfer; the jitted step bitcasts/slices the rows
+    back apart device-side, which XLA fuses to nothing.
+    """
+
+    data: jnp.ndarray  # [len(layout)+1, capacity] int32; last row = valid
+    layout: Tuple[Tuple[str, str], ...]  # (column, kind: i32|f32|bool)
+
+    def tree_flatten(self):
+        return (self.data,), self.layout
+
+    @classmethod
+    def tree_unflatten(cls, layout, children):
+        return cls(children[0], layout)
+
+    def unpack(self) -> TableData:
+        """Device-side (traceable) split back into named columns."""
+        cols: Dict[str, jnp.ndarray] = {}
+        for i, (name, kind) in enumerate(self.layout):
+            row = self.data[i]
+            if kind == "f32":
+                row = jax.lax.bitcast_convert_type(row, jnp.float32)
+            elif kind == "bool":
+                row = row != 0
+            cols[name] = row
+        return TableData(cols, self.data[len(self.layout)] != 0)
+
+
+def pack_raw(np_cols: Dict[str, np.ndarray], valid: np.ndarray) -> PackedRaw:
+    """Stack host columns into the single-transfer matrix (cheap host
+    memcpy; the win is one device transfer instead of n_cols+1)."""
+    rows: List[np.ndarray] = []
+    layout: List[Tuple[str, str]] = []
+    for c, a in np_cols.items():
+        if a.dtype == np.float32:
+            kind = "f32"
+            a = a.view(np.int32)
+        elif a.dtype == np.float64:
+            kind = "f32"
+            a = a.astype(np.float32).view(np.int32)
+        elif a.dtype == np.bool_:
+            kind = "bool"
+            a = a.astype(np.int32)
+        else:
+            kind = "i32"
+            if a.dtype != np.int32:
+                a = a.astype(np.int32)  # x64-off semantics: wrap like jnp
+        rows.append(a)
+        layout.append((c, kind))
+    rows.append(valid.astype(np.int32))
+    return PackedRaw(jnp.asarray(np.stack(rows)), tuple(layout))
+
+
 @dataclass
 class SourceSpec:
     """One named input stream of a flow: its schema, projection chain,
@@ -180,6 +247,22 @@ class FlowProcessor:
     ):
         self.dict = dict_
         self.dictionary = dictionary or StringDictionary()
+        # dictionary capacity bound (see StringDictionary.__init__) —
+        # applied even to an injected shared dictionary so the flow conf
+        # stays authoritative
+        sd_conf = dict_.get_sub_dictionary(
+            SettingNamespace.JobProcessPrefix + "stringdictionary."
+        )
+        maxsize = sd_conf.get_int_option("maxsize")
+        if maxsize is not None:
+            if maxsize < 1:
+                raise EngineException(
+                    f"process.stringdictionary.maxsize must be >= 1, "
+                    f"got {maxsize}"
+                )
+            self.dictionary.max_size = maxsize
+        if (sd_conf.get_or_else("strict", "false") or "").lower() == "true":
+            self.dictionary.strict = True
         # conf-declared UDFs (jar.udf/jar.udaf namespaces) + direct ones;
         # reference: ExtendedUDFHandler/JarUDFHandler reflection loading
         from ..udf import load_udfs_from_conf
@@ -527,8 +610,10 @@ class FlowProcessor:
     # -- window-state checkpoint ------------------------------------------
     def snapshot_window_state(self) -> Dict[str, object]:
         """Host copy of everything a restart would otherwise lose: the
-        window ring buffers, the slot counter, and the time base the ring
-        timestamps are relative to. Numpy-only; feed to
+        window ring buffers, the slot counter, the time base the ring
+        timestamps are relative to, AND the string dictionary — ring
+        columns hold dictionary ids, which only mean anything against
+        the dictionary that encoded them. Numpy-only; feed to
         ``WindowStateCheckpointer.save`` (reference restores window state
         via the StreamingContext checkpoint, StreamingHost.scala:83-89)."""
         rings = {}
@@ -541,12 +626,22 @@ class FlowProcessor:
             "rings": rings,
             "slot_counter": self._slot_counter,
             "base_ms": self._base_ms,
+            "dictionary": self.dictionary.entries(),
         }
 
     def restore_window_state(self, snap: Dict[str, object]) -> bool:
         """Restore a ``snapshot_window_state`` result. Shape-checked: a
         conf change that resized the rings invalidates the snapshot
-        (returns False and keeps the fresh zero state)."""
+        (returns False and keeps the fresh zero state). The saved
+        dictionary must agree with the strings this process has already
+        encoded (same conf => same compile-time literals in the same
+        order); on agreement the remaining saved entries replay so every
+        restored ring id decodes to the string it meant before the
+        restart."""
+        saved_dict = snap.get("dictionary")
+        if saved_dict is not None:
+            if not self.dictionary.restore_entries(saved_dict):
+                return False
         rings = snap.get("rings", {})
         restored: Dict[str, WindowBuffers] = {}
         for table, buf in self.window_buffers.items():
@@ -608,9 +703,12 @@ class FlowProcessor:
             # gets its own env so `Raw` binds to ITS raw table)
             projected: Dict[str, TableData] = {}
             for spec in specs:
+                rt = raw[spec.name]
+                if isinstance(rt, PackedRaw):
+                    rt = rt.unpack()  # split the single-transfer matrix
                 env: Dict[str, TableData] = {
-                    "Raw": raw[spec.name],
-                    DatasetName.DataStreamRaw: raw[spec.name],
+                    "Raw": rt,
+                    DatasetName.DataStreamRaw: rt,
                     "__aux": aux,
                 }
                 for v in proj_views[spec.name]:
@@ -728,16 +826,27 @@ class FlowProcessor:
         return TableData(cols, b.valid)
 
     def encode_json_bytes(
-        self, data: bytes, base_ms: int, source: Optional[str] = None
-    ) -> TableData:
+        self,
+        data: bytes,
+        base_ms: int,
+        source: Optional[str] = None,
+        packed: Optional[bool] = None,
+    ) -> Union[TableData, "PackedRaw"]:
         """Native ingest hot path: newline-delimited JSON bytes decoded by
         the C++ decoder (native/decoder.cpp) straight into columnar
         buffers — the from_json role at CommonProcessorFactory.scala:90-103
         without any per-event Python objects. Falls back to the Python
-        row encoder if the native library is unavailable."""
+        row encoder if the native library is unavailable.
+
+        ``packed`` (default: auto — on for single-chip, off under a
+        mesh, whose row shardings expect [capacity] leaves): ship the
+        batch as ONE stacked host->device transfer (PackedRaw) instead
+        of one per column."""
         from ..native import native_available
 
         spec = self._spec(source)
+        if packed is None:
+            packed = self.mesh is None
         if not native_available():
             import json as _json
 
@@ -766,7 +875,7 @@ class FlowProcessor:
                 + decoder.last_bad_timestamps
             )
         cap = spec.capacity
-        cols: Dict[str, jnp.ndarray] = {}
+        np_cols: Dict[str, np.ndarray] = {}
         for col in spec.schema.columns:
             a = arrays[col.name]
             if col.ctype == ColType.TIMESTAMP:
@@ -780,14 +889,19 @@ class FlowProcessor:
                 ).astype(np.int32)
             elif col.ctype == ColType.BOOLEAN:
                 a = a.astype(np.bool_)
-            cols[col.name] = jnp.asarray(a)
+            np_cols[col.name] = a
         for extra in (
             ColumnName.RawPropertiesColumn,
             ColumnName.RawSystemPropertiesColumn,
         ):
-            if extra in spec.raw_schema.types and extra not in cols:
-                cols[extra] = jnp.zeros((cap,), jnp.int32)
-        return TableData(cols, jnp.asarray(valid))
+            if extra in spec.raw_schema.types and extra not in np_cols:
+                np_cols[extra] = np.zeros(cap, np.int32)
+        if packed:
+            return pack_raw(np_cols, np.asarray(valid))
+        return TableData(
+            {c: jnp.asarray(a) for c, a in np_cols.items()},
+            jnp.asarray(valid),
+        )
 
     def encode_columns(
         self, np_cols: Dict[str, np.ndarray], n: int,
@@ -832,7 +946,7 @@ class FlowProcessor:
         t0 = time.time()
         if batch_time_ms is None:
             batch_time_ms = int(time.time() * 1000)
-        if isinstance(raw, TableData):
+        if isinstance(raw, (TableData, PackedRaw)):
             raw = {self.primary: raw}
         for name in raw:
             if name not in self.specs:
@@ -1077,4 +1191,9 @@ class PendingBatch:
                 if v:
                     metrics[f"Input_{k}_Count"] = float(v)
             proc.ingest_stats.clear()
+        if proc.dictionary.overflow_count:
+            metrics["Input_string_dictionary_overflow_Count"] = float(
+                proc.dictionary.overflow_count
+            )
+            proc.dictionary.overflow_count = 0
         return datasets, metrics

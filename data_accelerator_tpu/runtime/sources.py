@@ -676,7 +676,8 @@ def make_source(conf, schema: Schema, source: str = "default") -> StreamingSourc
         return KafkaSource(
             conf.get_or_else("kafka.bootstrapservers", "localhost:9092"),
             [t for t in topics if t],
-            group_id=conf.get_or_else("kafka.groupid", "dxtpu"),
+            group_id=conf.get_or_else("kafka.groupid", nm("dxtpu")),
+            name=nm("kafka"),
         )
     if input_type == "blobpointer":
         # pointer events arrive over socket or from a pointer file

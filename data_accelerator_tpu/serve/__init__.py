@@ -7,10 +7,24 @@ jobs out.
 """
 
 from .templating import TokenDictionary
-from .storage import JobRegistry, LocalDesignTimeStorage, LocalRuntimeStorage
+from .storage import (
+    JobRegistry,
+    LocalDesignTimeStorage,
+    LocalRuntimeStorage,
+    ObjectDesignTimeStorage,
+    ObjectRuntimeStorage,
+)
+from .objectstore import ObjectStoreClient, ObjectStoreServer
 from .flowbuilder import FlowConfigBuilder, RuleDefinitionGenerator
 from .generation import RuntimeConfigGeneration
-from .jobs import JobOperation, JobState, LocalJobClient, TpuJobClient
+from .jobs import (
+    JobOperation,
+    JobState,
+    K8sJobClient,
+    LocalJobClient,
+    TpuJobClient,
+    make_job_client,
+)
 from .flowservice import FlowOperation
 from .schemainference import SchemaInferenceManager, infer_schema
 from .sqlanalyzer import SqlAnalyzer
@@ -23,13 +37,19 @@ __all__ = [
     "JobRegistry",
     "LocalDesignTimeStorage",
     "LocalRuntimeStorage",
+    "ObjectDesignTimeStorage",
+    "ObjectRuntimeStorage",
+    "ObjectStoreClient",
+    "ObjectStoreServer",
     "FlowConfigBuilder",
     "RuleDefinitionGenerator",
     "RuntimeConfigGeneration",
     "JobOperation",
     "JobState",
+    "K8sJobClient",
     "LocalJobClient",
     "TpuJobClient",
+    "make_job_client",
     "FlowOperation",
     "SchemaInferenceManager",
     "infer_schema",

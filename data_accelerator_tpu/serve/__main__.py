@@ -11,6 +11,19 @@ Args (key=value):
   authfile=          gateway auth table JSON (token -> user/roles)
   ingest=0           metrics-ingestor TCP port (0 = off)
   scheduler=0        batch scheduler tick seconds (0 = off)
+  objectstore=       design/runtime configs in a shared object store:
+                     an endpoint URL (http://host:port) to use an
+                     external store, or serve:<port> to also run the
+                     bundled store server here (workers point at it)
+  objectstore.host=  bundled store bind address (0.0.0.0 for remote
+                     workers; default 127.0.0.1)
+  objectstore.advertise=  endpoint URL baked into generated objstore://
+                     conf references (must be reachable from workers)
+  jobclient=local    job submission: local (child processes) or k8s
+  k8s.apiserver=     k8s API server URL (default in-cluster)
+  k8s.namespace=     k8s namespace (default "default")
+  k8s.image=         engine image for rendered TPU Jobs
+  k8s.tokenfile=     bearer-token file (default service-account path)
 
 The one-box analog of the reference's local container entry
 (DeploymentLocal/finalrun.sh): flow services + gateway + website +
@@ -41,9 +54,48 @@ def main(argv=None):
         env_tokens["localMetricsHttpEndpoint"] = (
             f"http://127.0.0.1:{web_port}/metrics/post"
         )
+    parts_pre = []
+    objstore = args.get("objectstore")
+    if objstore:
+        from .objectstore import ObjectStoreClient, ObjectStoreServer
+        from .storage import ObjectDesignTimeStorage, ObjectRuntimeStorage
+
+        if objstore.startswith("serve:"):
+            store = ObjectStoreServer(
+                port=int(objstore.split(":", 1)[1] or 0),
+                root=f"{root}/objectstore",
+                # workers on other hosts need a reachable bind+advertise
+                # (objectstore.host=0.0.0.0 objectstore.advertise=http://<ip>:<port>)
+                host=args.get("objectstore.host", "127.0.0.1"),
+                advertise=args.get("objectstore.advertise"),
+            ).start()
+            parts_pre.append(store)
+            endpoint = store.endpoint
+            log.info("bundled object store on %s", endpoint)
+        else:
+            endpoint = objstore
+        client = ObjectStoreClient(endpoint)
+        design_storage = ObjectDesignTimeStorage(client)
+        runtime_storage = ObjectRuntimeStorage(
+            client, scratch_dir=f"{root}/scratch"
+        )
+    else:
+        design_storage = LocalDesignTimeStorage(f"{root}/design")
+        runtime_storage = LocalRuntimeStorage(f"{root}/runtime")
+
+    job_client = None
+    if args.get("jobclient", "local") != "local":
+        from .jobs import make_job_client
+
+        job_client = make_job_client(
+            {"type": args["jobclient"],
+             **{k[4:]: v for k, v in args.items() if k.startswith("k8s.")}},
+        )
+
     flow_ops = FlowOperation(
-        LocalDesignTimeStorage(f"{root}/design"),
-        LocalRuntimeStorage(f"{root}/runtime"),
+        design_storage,
+        runtime_storage,
+        job_client=job_client,
         env_tokens=env_tokens,
     )
     api = DataXApi(
@@ -53,7 +105,7 @@ def main(argv=None):
     service.start()
     log.info("control plane on :%d (storage %s)", service.port, root)
 
-    parts = [service]
+    parts = parts_pre + [service]
     if int(args.get("ingest", "0") or 0):
         from ..obs.ingestor import MetricsIngestor
 

@@ -204,7 +204,7 @@ class RuntimeConfigGeneration:
         ctx["result"].files[schema_path] = (
             schema_json if isinstance(schema_json, str) else json.dumps(schema_json)
         )
-        tok.set("inputSchemaFilePath", self.runtime.resolve(schema_path))
+        tok.set("inputSchemaFilePath", self.runtime.stored_path(schema_path))
 
         # reference data passes straight through as the template value
         tok.set("inputReferenceData", [
@@ -233,7 +233,8 @@ class RuntimeConfigGeneration:
 
         transform_path = os.path.join(ctx["flow_dir"], f"{doc['name']}.transform")
         ctx["result"].files[transform_path] = rules_code.code
-        ctx["tokens"].set("processTransforms", self.runtime.resolve(transform_path))
+        ctx["tokens"].set("processTransforms",
+                          self.runtime.stored_path(transform_path))
 
     def _s500_resolve(self, ctx) -> None:
         """Resolve projections, UDFs, time windows, state tables, outputs
@@ -248,7 +249,7 @@ class RuntimeConfigGeneration:
         normalization = iprops.get("normalizationSnippet") or "Raw.*"
         proj_path = os.path.join(ctx["flow_dir"], f"{doc['name']}.projection")
         ctx["result"].files[proj_path] = normalization
-        tok.set("processProjections", [self.runtime.resolve(proj_path)])
+        tok.set("processProjections", [self.runtime.stored_path(proj_path)])
 
         # functions -> jar UDFs / UDAFs / azure functions template arrays
         jar_udfs, jar_udafs, azure_fns = [], [], []

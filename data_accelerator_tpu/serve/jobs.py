@@ -140,6 +140,211 @@ class LocalJobClient(TpuJobClient):
         return job.get("state") or JobState.Idle
 
 
+class K8sJobClient(TpuJobClient):
+    """Submits flow jobs as Kubernetes Jobs on a TPU node pool.
+
+    The cluster-submission role Livy/Databricks REST plays for the
+    reference (DataX.Config.LivyClient/LivyClient.cs:81-94 submit/poll/
+    delete of cluster batches; state mapping per
+    InternalService/SparkJobOperation.cs:42-268): render the
+    ``deploy/k8s/tpu-job.yaml`` manifest for the flow, POST it to the
+    k8s batch API, derive JobState from the Job's status counts, DELETE
+    (foreground propagation) to stop.
+
+    Auth follows the in-cluster convention: bearer token from
+    ``token``/``token_file`` (defaults to the service-account token
+    path). ``http`` is the transport — ``(method, url, body|None) ->
+    (status_code, parsed_json)`` — injectable for tests and for custom
+    TLS setups.
+    """
+
+    TOKEN_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+
+    def __init__(
+        self,
+        api_server: str,
+        namespace: str = "default",
+        token: Optional[str] = None,
+        token_file: Optional[str] = None,
+        image: str = "dxtpu:latest",
+        manifest_path: Optional[str] = None,
+        http=None,
+        insecure: bool = False,
+    ):
+        self.api_server = api_server.rstrip("/")
+        self.namespace = namespace
+        self.image = image
+        self.manifest_path = manifest_path or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            "deploy", "k8s", "tpu-job.yaml",
+        )
+        self._token = token
+        self._token_file = token_file
+        self.insecure = insecure
+        self._http = http or self._urllib_http
+
+    # -- transport -------------------------------------------------------
+    def _bearer(self) -> Optional[str]:
+        if self._token:
+            return self._token
+        path = self._token_file or self.TOKEN_PATH
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                return f.read().strip()
+        return None
+
+    def _urllib_http(self, method: str, url: str, body: Optional[dict]):
+        import ssl
+        import urllib.error
+        import urllib.request
+
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Content-Type", "application/json")
+        req.add_header("Accept", "application/json")
+        tok = self._bearer()
+        if tok:
+            req.add_header("Authorization", f"Bearer {tok}")
+        ctx = ssl._create_unverified_context() if self.insecure else None
+        try:
+            with urllib.request.urlopen(req, context=ctx, timeout=30) as r:
+                return r.status, json.loads(r.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read().decode() or "{}")
+            except ValueError:
+                payload = {}
+            return e.code, payload
+
+    # -- manifest --------------------------------------------------------
+    def _k8s_name(self, job: dict) -> str:
+        safe = "".join(
+            c if c.isalnum() or c == "-" else "-" for c in job["name"].lower()
+        ).strip("-")
+        return f"dxtpu-job-{safe}"
+
+    def render_manifest(self, job: dict) -> dict:
+        """deploy/k8s/tpu-job.yaml with FLOWNAME/JOBNAME substituted —
+        the manifest IS the submission payload (no drift between the
+        documented shape and what the client sends)."""
+        import yaml
+
+        with open(self.manifest_path, encoding="utf-8") as f:
+            text = f.read()
+        flow = job.get("flowName") or job["name"]
+        text = text.replace("FLOWNAME", flow).replace("JOBNAME", job["name"])
+        manifest = yaml.safe_load(text)
+        manifest["metadata"]["name"] = self._k8s_name(job)
+        manifest["metadata"].setdefault("labels", {})["job"] = job["name"]
+        container = manifest["spec"]["template"]["spec"]["containers"][0]
+        container["image"] = self.image
+        if job.get("confPath"):
+            container["args"] = [f"conf={job['confPath']}"]
+        if job.get("batches"):
+            container["args"].append(f"batches={job['batches']}")
+        return manifest
+
+    def _jobs_url(self, name: Optional[str] = None) -> str:
+        base = (
+            f"{self.api_server}/apis/batch/v1/namespaces/"
+            f"{self.namespace}/jobs"
+        )
+        return f"{base}/{name}" if name else base
+
+    # -- TpuJobClient ----------------------------------------------------
+    def submit(self, job: dict) -> dict:
+        manifest = self.render_manifest(job)
+        status, body = self._http("POST", self._jobs_url(), manifest)
+        if status == 409:
+            # already exists: delete the finished run, then resubmit
+            # (Livy parity: a batch id is single-use; k8s Jobs likewise)
+            self._delete(self._k8s_name(job))
+            self._wait_gone(self._k8s_name(job))
+            status, body = self._http("POST", self._jobs_url(), manifest)
+        if status not in (200, 201, 202):
+            raise RuntimeError(
+                f"k8s job submit failed ({status}): "
+                f"{body.get('message', body)}"
+            )
+        job["clientId"] = self._k8s_name(job)
+        job["state"] = JobState.Starting
+        logger.info(
+            "submitted k8s job %s as %s", job["name"], job["clientId"]
+        )
+        return job
+
+    def _delete(self, k8s_name: str):
+        return self._http(
+            "DELETE",
+            self._jobs_url(k8s_name),
+            {"propagationPolicy": "Foreground"},
+        )
+
+    def _wait_gone(self, k8s_name: str, timeout_s: float = 30):
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            status, _ = self._http("GET", self._jobs_url(k8s_name), None)
+            if status == 404:
+                return
+            time.sleep(0.5)
+
+    def stop(self, job: dict) -> dict:
+        name = job.get("clientId") or self._k8s_name(job)
+        status, body = self._delete(name)
+        if status not in (200, 202, 404):
+            raise RuntimeError(
+                f"k8s job delete failed ({status}): "
+                f"{body.get('message', body)}"
+            )
+        job["state"] = JobState.Idle
+        job["clientId"] = None
+        return job
+
+    def get_state(self, job: dict) -> str:
+        name = job.get("clientId") or self._k8s_name(job)
+        status, body = self._http("GET", self._jobs_url(name), None)
+        if status == 404:
+            return job.get("state") if job.get("state") in (
+                JobState.Idle, JobState.Success, JobState.Error
+            ) else JobState.Idle
+        if status != 200:
+            raise RuntimeError(f"k8s job get failed ({status})")
+        s = body.get("status", {}) or {}
+        spec = body.get("spec", {}) or {}
+        if s.get("active"):
+            return JobState.Running
+        if s.get("succeeded"):
+            return JobState.Success
+        if s.get("failed", 0) > spec.get("backoffLimit", 0):
+            return JobState.Error
+        if s.get("failed"):
+            return JobState.Starting  # retrying within backoffLimit
+        return JobState.Starting  # created, pods not yet scheduled
+
+
+def make_job_client(conf: Optional[dict] = None, log_dir: Optional[str] = None):
+    """Client factory keyed by conf — the role the reference's client
+    factory plays choosing Livy vs Databricks vs local
+    (DataX.Config/ConfigGenConfiguration SparkType switch)."""
+    conf = conf or {}
+    kind = (conf.get("type") or "local").lower()
+    if kind == "local":
+        return LocalJobClient(log_dir=log_dir, env=conf.get("env"))
+    if kind in ("k8s", "kubernetes"):
+        return K8sJobClient(
+            api_server=conf.get("apiserver")
+            or "https://kubernetes.default.svc",
+            namespace=conf.get("namespace", "default"),
+            token=conf.get("token"),
+            token_file=conf.get("tokenfile"),
+            image=conf.get("image", "dxtpu:latest"),
+            manifest_path=conf.get("manifest"),
+            insecure=str(conf.get("insecure", "")).lower() == "true",
+        )
+    raise ValueError(f"unknown job client type {kind!r}")
+
+
 class JobOperation:
     """Start/stop/restart with bounded retries + state sync.
 

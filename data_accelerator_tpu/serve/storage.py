@@ -92,6 +92,17 @@ class RuntimeStorage:
     def exists(self, path: str) -> bool:
         raise NotImplementedError
 
+    def list_files(self, prefix: str) -> List[str]:
+        """Relative paths of stored files under ``prefix``."""
+        raise NotImplementedError
+
+    def stored_path(self, path: str) -> str:
+        """The reference a generated conf should carry for a stored
+        artifact — a local absolute path here, an objstore:// URL for
+        the object backend (workers resolve it via utils/fs.read_text,
+        the HadoopClient-chokepoint role)."""
+        raise NotImplementedError
+
     def delete_all(self, prefix: str) -> None:
         raise NotImplementedError
 
@@ -106,6 +117,9 @@ class LocalRuntimeStorage(RuntimeStorage):
 
     def resolve(self, path: str) -> str:
         return path if os.path.isabs(path) else os.path.join(self.root, path)
+
+    def stored_path(self, path: str) -> str:
+        return self.resolve(path)
 
     def save_file(self, path: str, content: str) -> str:
         full = self.resolve(path)
@@ -123,6 +137,19 @@ class LocalRuntimeStorage(RuntimeStorage):
     def exists(self, path: str) -> bool:
         return os.path.exists(self.resolve(path))
 
+    def list_files(self, prefix: str) -> List[str]:
+        base = self.resolve(prefix)
+        if os.path.isfile(base):
+            return [prefix]
+        out: List[str] = []
+        for dirpath, _dirs, files in os.walk(base):
+            for fn in files:
+                if fn.endswith(".tmp"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, fn), self.root)
+                out.append(rel.replace(os.sep, "/"))
+        return sorted(out)
+
     def delete_all(self, prefix: str) -> None:
         full = os.path.realpath(self.resolve(prefix))
         root = os.path.realpath(self.root)
@@ -136,6 +163,111 @@ class LocalRuntimeStorage(RuntimeStorage):
             os.remove(full)
 
 
+class ObjectDesignTimeStorage(DesignTimeStorage):
+    """Flow documents in a shared object store — the CosmosDB-role
+    backend (reference: DataX.Config.Storage CosmosDB impl of
+    IDesignTimeConfigStorage) so every control-plane replica sees the
+    same designs. Keys: ``design/<name>.json``."""
+
+    PREFIX = "design/"
+
+    def __init__(self, client):
+        from .objectstore import ObjectStoreClient  # noqa: F401 — type
+
+        self.client = client
+
+    def _key(self, name: str) -> str:
+        safe = "".join(c for c in name if c.isalnum() or c in "-_.")
+        return f"{self.PREFIX}{safe}.json"
+
+    def get_by_name(self, name: str) -> Optional[dict]:
+        data = self.client.get(self._key(name))
+        return json.loads(data.decode()) if data is not None else None
+
+    def get_all(self) -> List[dict]:
+        out = []
+        for key in self.client.list(self.PREFIX):
+            data = self.client.get(key)
+            if data is not None:
+                out.append(json.loads(data.decode()))
+        return out
+
+    def save(self, doc: dict) -> dict:
+        name = doc.get("name")
+        if not name:
+            raise ValueError("flow document requires a 'name'")
+        self.client.put(self._key(name), json.dumps(doc, indent=1).encode())
+        return doc
+
+    def delete(self, name: str) -> bool:
+        return self.client.delete(self._key(name))
+
+
+class ObjectRuntimeStorage(RuntimeStorage):
+    """Runtime artifacts in the shared object store — the blob-storage
+    role (reference: IRuntimeConfigStorage blob impl), so a job
+    submitted to a cluster host reads the exact configs the control
+    plane generated. ``save_file`` returns an ``objstore://`` URL the
+    engine resolves at startup (core/confmanager.py); local scratch
+    (``resolve``) stays on disk for logs."""
+
+    PREFIX = "runtime/"
+
+    def __init__(self, client, scratch_dir: Optional[str] = None):
+        self.client = client
+        self.scratch = scratch_dir or os.path.join(
+            os.path.expanduser("~"), ".dxtpu-scratch"
+        )
+
+    def _key(self, path: str) -> str:
+        return self.PREFIX + path.lstrip("/")
+
+    def resolve(self, path: str) -> str:
+        """Local scratch path (logs etc. — host-local by design)."""
+        if os.path.isabs(path):
+            return path
+        full = os.path.join(self.scratch, path)
+        os.makedirs(os.path.dirname(full) or full, exist_ok=True)
+        return full
+
+    def save_file(self, path: str, content: str) -> str:
+        key = self._key(path)
+        self.client.put(key, content.encode())
+        return self.client.url_for(key)
+
+    def read_file(self, path: str) -> str:
+        data = self.client.get(self._key(path))
+        if data is None:
+            raise FileNotFoundError(path)
+        return data.decode()
+
+    def exists(self, path: str) -> bool:
+        return self.client.get(self._key(path)) is not None
+
+    def list_files(self, prefix: str) -> List[str]:
+        # directory semantics like the local backend: an exact-key file,
+        # plus keys under the '/'-terminated prefix (a bare string
+        # prefix would also match sibling flows sharing the spelling)
+        n = len(self.PREFIX)
+        key = self._key(prefix)
+        out = []
+        if prefix and self.client.get(key) is not None:
+            out.append(prefix)
+        term = key.rstrip("/") + "/" if prefix else self.PREFIX
+        out.extend(k[n:] for k in self.client.list(term))
+        return sorted(out)
+
+    def stored_path(self, path: str) -> str:
+        return self.client.url_for(self._key(path))
+
+    def delete_all(self, prefix: str) -> None:
+        # exact file, then the '/'-terminated subtree — never a bare
+        # string prefix (deleting flow "iot" must not touch "iot2")
+        key = self._key(prefix)
+        self.client.delete(key)
+        self.client.delete_prefix(key.rstrip("/") + "/")
+
+
 class JobRegistry:
     """Job records (name -> record dict), stored alongside runtime configs.
 
@@ -143,7 +275,7 @@ class JobRegistry:
     design-time store, upserted by S800_DeploySparkJob.cs:23-60.
     """
 
-    def __init__(self, storage: LocalRuntimeStorage):
+    def __init__(self, storage: RuntimeStorage):
         self.storage = storage
         self._lock = threading.Lock()
 
@@ -159,22 +291,17 @@ class JobRegistry:
         return existing
 
     def get(self, name: str) -> Optional[dict]:
-        if not self.storage.exists(self._path(name)):
+        try:
+            return json.loads(self.storage.read_file(self._path(name)))
+        except FileNotFoundError:
             return None
-        return json.loads(self.storage.read_file(self._path(name)))
 
     def get_all(self) -> List[dict]:
-        jobs_dir = self.storage.resolve("jobs")
-        if not os.path.isdir(jobs_dir):
-            return []
         out = []
-        for fn in sorted(os.listdir(jobs_dir)):
-            if fn.endswith(".json"):
-                out.append(json.loads(self.storage.read_file(
-                    os.path.join("jobs", fn))))
+        for rel in self.storage.list_files("jobs"):
+            if rel.endswith(".json"):
+                out.append(json.loads(self.storage.read_file(rel)))
         return out
 
     def delete(self, name: str) -> None:
-        p = self.storage.resolve(self._path(name))
-        if os.path.exists(p):
-            os.remove(p)
+        self.storage.delete_all(self._path(name))

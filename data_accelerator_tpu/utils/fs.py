@@ -37,7 +37,20 @@ def is_gzip(path: str) -> bool:
 
 
 def read_text(path: str) -> str:
-    """Gzip-aware whole-file text read (HadoopClient gzip read path)."""
+    """Gzip-aware whole-file text read (HadoopClient gzip read path).
+
+    This is the fs chokepoint (reference: HadoopClient.scala resolves
+    wasbs/abfs/local URIs in one place): ``objstore://`` URLs fetch from
+    the shared object store, so any engine conf value may point at a
+    file the control plane stored remotely."""
+    if path.startswith("objstore://"):
+        import os as _os
+
+        from ..serve.objectstore import fetch_objstore_url
+
+        return fetch_objstore_url(
+            path, token=_os.environ.get("DATAX_OBJSTORE_TOKEN")
+        )
     if is_gzip(path):
         with gzip.open(path, "rt", encoding="utf-8") as f:
             return f.read()

@@ -1,0 +1,267 @@
+"""Cluster job client (k8s) + shared object-store storage.
+
+reference: LivyClient.cs:81-94 (REST submit/poll/delete of cluster
+batches), SparkJobOperation.cs:42-268 (state mapping), and the
+CosmosDB/blob storage impls behind
+DataX.Config/Storage/I{DesignTime,Runtime}ConfigStorage.cs.
+"""
+
+import json
+import threading
+
+import pytest
+
+from data_accelerator_tpu.serve.flowservice import FlowOperation
+from data_accelerator_tpu.serve.jobs import (
+    JobOperation,
+    JobState,
+    K8sJobClient,
+    make_job_client,
+)
+from data_accelerator_tpu.serve.objectstore import (
+    ObjectStoreClient,
+    ObjectStoreServer,
+    fetch_objstore_url,
+)
+from data_accelerator_tpu.serve.storage import (
+    JobRegistry,
+    LocalRuntimeStorage,
+    ObjectDesignTimeStorage,
+    ObjectRuntimeStorage,
+)
+
+from test_serve_generation import make_gui
+
+
+# -- a fake k8s API server (transport level) -------------------------------
+
+class FakeK8s:
+    """Mock transport: implements the batch/v1 Jobs REST surface the
+    client uses, recording manifests and serving controllable status."""
+
+    def __init__(self):
+        self.jobs = {}          # k8s name -> manifest
+        self.status = {}        # k8s name -> status dict
+        self.requests = []
+
+    def __call__(self, method, url, body):
+        self.requests.append((method, url))
+        name = url.rsplit("/jobs", 1)[-1].lstrip("/").split("?")[0]
+        if method == "POST":
+            jname = body["metadata"]["name"]
+            if jname in self.jobs:
+                return 409, {"message": "AlreadyExists"}
+            self.jobs[jname] = body
+            self.status.setdefault(jname, {})
+            return 201, body
+        if method == "GET":
+            if name not in self.jobs:
+                return 404, {}
+            return 200, {
+                "spec": {"backoffLimit": 3},
+                "status": self.status.get(name, {}),
+            }
+        if method == "DELETE":
+            if self.jobs.pop(name, None) is None:
+                return 404, {}
+            self.status.pop(name, None)
+            return 200, {}
+        return 405, {}
+
+
+@pytest.fixture
+def k8s():
+    fake = FakeK8s()
+    client = K8sJobClient(
+        "https://k8s.example:6443", namespace="prod", image="dxtpu:v5",
+        http=fake, token="t",
+    )
+    return fake, client
+
+
+class TestK8sJobClient:
+    def test_submit_renders_manifest(self, k8s):
+        fake, client = k8s
+        job = {"name": "MyFlow-job", "flowName": "MyFlow",
+               "confPath": "objstore://h/b/runtime/MyFlow/MyFlow-job.conf"}
+        out = client.submit(job)
+        assert out["clientId"] == "dxtpu-job-myflow-job"
+        assert out["state"] == JobState.Starting
+        m = fake.jobs["dxtpu-job-myflow-job"]
+        assert m["kind"] == "Job"
+        c = m["spec"]["template"]["spec"]["containers"][0]
+        assert c["image"] == "dxtpu:v5"
+        assert c["args"] == [
+            "conf=objstore://h/b/runtime/MyFlow/MyFlow-job.conf"
+        ]
+        assert m["metadata"]["labels"]["flow"] == "MyFlow"
+        # TPU placement from the manifest template survives rendering
+        assert "google.com/tpu" in c["resources"]["limits"]
+        # submit went to the right namespace collection
+        assert any("/namespaces/prod/jobs" in u for _m, u in fake.requests)
+
+    def test_state_mapping(self, k8s):
+        fake, client = k8s
+        job = {"name": "f1", "confPath": "x.conf"}
+        client.submit(job)
+        k = job["clientId"]
+        assert client.get_state(job) == JobState.Starting
+        fake.status[k] = {"active": 1}
+        assert client.get_state(job) == JobState.Running
+        fake.status[k] = {"succeeded": 1}
+        assert client.get_state(job) == JobState.Success
+        fake.status[k] = {"failed": 2}  # within backoffLimit: retrying
+        assert client.get_state(job) == JobState.Starting
+        fake.status[k] = {"failed": 5}  # beyond backoffLimit
+        assert client.get_state(job) == JobState.Error
+
+    def test_stop_deletes_job(self, k8s):
+        fake, client = k8s
+        job = {"name": "f1", "confPath": "x.conf"}
+        client.submit(job)
+        out = client.stop(job)
+        assert out["state"] == JobState.Idle
+        assert fake.jobs == {}
+        # stopping again is a no-op (404 tolerated)
+        client.stop({"name": "f1", "clientId": "dxtpu-job-f1"})
+
+    def test_resubmit_after_finished_run(self, k8s):
+        fake, client = k8s
+        job = {"name": "f1", "confPath": "x.conf"}
+        client.submit(job)
+        # job finished; a new start hits 409 then deletes + resubmits
+        fake.status[job["clientId"]] = {"succeeded": 1}
+        out = client.submit({"name": "f1", "confPath": "x.conf"})
+        assert out["state"] == JobState.Starting
+        assert "dxtpu-job-f1" in fake.jobs
+
+    def test_job_operation_lifecycle_on_k8s(self, tmp_path, k8s):
+        fake, client = k8s
+        registry = JobRegistry(LocalRuntimeStorage(str(tmp_path)))
+        registry.upsert({"name": "f1", "confPath": "c.conf",
+                         "state": JobState.Idle})
+        ops = JobOperation(registry, client, retry_interval_s=0.01)
+        job = ops.start_job_with_retries("f1")
+        assert job["state"] == JobState.Starting
+        fake.status[job["clientId"]] = {"active": 1}
+        assert ops.sync_job_state("f1")["state"] == JobState.Running
+        job = ops.stop_job_with_retries("f1")
+        assert job["state"] == JobState.Idle
+        job = ops.restart_job("f1")
+        assert job["state"] == JobState.Starting
+
+    def test_factory(self):
+        c = make_job_client({"type": "k8s", "apiserver": "https://x:1",
+                             "namespace": "ns"})
+        assert isinstance(c, K8sJobClient)
+        assert c.namespace == "ns"
+        with pytest.raises(ValueError):
+            make_job_client({"type": "slurm"})
+
+
+# -- object store ----------------------------------------------------------
+
+@pytest.fixture
+def store(tmp_path):
+    srv = ObjectStoreServer(root=str(tmp_path / "store")).start()
+    yield srv
+    srv.stop()
+
+
+class TestObjectStore:
+    def test_roundtrip_over_http(self, store):
+        c = ObjectStoreClient(store.endpoint, "b1")
+        c.put("a/x.conf", b"hello")
+        c.put("a/y.conf", b"there")
+        c.put("z.txt", b"!")
+        assert c.get("a/x.conf") == b"hello"
+        assert c.get("missing") is None
+        assert c.list("a/") == ["a/x.conf", "a/y.conf"]
+        assert c.delete("a/x.conf") is True
+        assert c.delete("a/x.conf") is False
+        assert c.list("") == ["a/y.conf", "z.txt"]
+        assert c.delete_prefix("a/") == 1
+
+    def test_token_auth(self, tmp_path):
+        srv = ObjectStoreServer(root=str(tmp_path / "s"), token="sec").start()
+        try:
+            bad = ObjectStoreClient(srv.endpoint, "b")
+            with pytest.raises(IOError):
+                bad.put("k", b"v")
+            good = ObjectStoreClient(srv.endpoint, "b", token="sec")
+            good.put("k", b"v")
+            assert good.get("k") == b"v"
+        finally:
+            srv.stop()
+
+    def test_key_traversal_rejected(self, store):
+        c = ObjectStoreClient(store.endpoint, "b")
+        with pytest.raises(IOError):
+            c.put("../escape", b"x")
+
+    def test_sibling_prefix_flows_isolated(self, store, tmp_path):
+        """Deleting flow 'iot' must not touch flow 'iot2' (prefix
+        deletion is '/'-terminated, matching the local backend)."""
+        c = ObjectStoreClient(store.endpoint, "b")
+        rt = ObjectRuntimeStorage(c, scratch_dir=str(tmp_path / "s"))
+        rt.save_file("iot/a.conf", "1")
+        rt.save_file("iot2/a.conf", "2")
+        rt.delete_all("iot")
+        assert not rt.exists("iot/a.conf")
+        assert rt.read_file("iot2/a.conf") == "2"
+        assert rt.list_files("iot2") == ["iot2/a.conf"]
+
+    def test_fetch_objstore_url(self, store):
+        c = ObjectStoreClient(store.endpoint, "bkt")
+        url = c.url_for("runtime/f/j.conf")
+        c.put("runtime/f/j.conf", b"datax.job.name=X\n")
+        assert url.startswith("objstore://127.0.0.1:")
+        assert fetch_objstore_url(url) == "datax.job.name=X\n"
+
+
+class TestObjectBackedControlPlane:
+    def test_flow_generate_jobs_on_object_storage(self, tmp_path, store):
+        """The full design->generate->job-registry path against the
+        shared store: a second FlowOperation (another 'host') sees the
+        same flows/jobs, and generated confs come back as objstore://
+        URLs a worker can fetch."""
+        client = ObjectStoreClient(store.endpoint, "dxtpu")
+        design = ObjectDesignTimeStorage(client)
+        runtime = ObjectRuntimeStorage(
+            client, scratch_dir=str(tmp_path / "scratch")
+        )
+        ops = FlowOperation(design, runtime)
+        ops.save_flow(make_gui("ObjFlow"))
+        res = ops.generate_configs("ObjFlow")
+        assert res.ok, res.errors
+
+        job = ops.registry.get_all()[0]
+        assert job["confPath"].startswith("objstore://")
+        conf_text = fetch_objstore_url(job["confPath"])
+        assert "datax.job.name" in conf_text
+
+        # a second control-plane instance on "another host"
+        ops2 = FlowOperation(
+            ObjectDesignTimeStorage(client),
+            ObjectRuntimeStorage(client, scratch_dir=str(tmp_path / "s2")),
+        )
+        assert [f["name"] for f in ops2.get_all_flows()] == ["ObjFlow"]
+        assert ops2.registry.get(job["name"])["confPath"] == job["confPath"]
+
+        # cascade delete clears design + runtime + jobs in the store
+        ops2.delete_flow("ObjFlow")
+        assert ops.get_all_flows() == []
+        assert client.list("runtime/ObjFlow") == []
+
+    def test_engine_loads_objstore_conf(self, store, tmp_path):
+        from data_accelerator_tpu.core.confmanager import ConfigManager
+
+        client = ObjectStoreClient(store.endpoint, "dxtpu")
+        key = "runtime/F/F-job.conf"
+        client.put(key, b"datax.job.name=FromStore\n")
+        url = client.url_for(key)
+        ConfigManager.reset()
+        ConfigManager.get_configuration_from_arguments([f"conf={url}"])
+        d = ConfigManager.load_config()
+        assert d.get_job_name() == "FromStore"
+        ConfigManager.reset()

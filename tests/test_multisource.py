@@ -304,6 +304,57 @@ def test_window_state_survives_restart(tmp_path):
     assert agg[5] == 2
 
 
+def test_window_state_restart_preserves_string_ids(tmp_path):
+    """Ring columns hold dictionary ids; the snapshot carries the
+    dictionary so a restarted process decodes restored ids to the SAME
+    strings (a fresh dictionary would silently rebind them)."""
+    str_schema = json.dumps({"type": "struct", "fields": [
+        {"name": "site", "type": "string", "nullable": False, "metadata": {}},
+        {"name": "eventTimeStamp", "type": "timestamp", "nullable": False,
+         "metadata": {}},
+    ]})
+    transform = (
+        "--DataXQuery--\n"
+        "BySite = SELECT site, COUNT(*) AS Cnt "
+        "FROM DataXProcessedInput_10seconds GROUP BY site\n"
+    )
+
+    def conf(sub):
+        d = tmp_path / sub
+        d.mkdir(parents=True, exist_ok=True)
+        t = d / "flow.transform"
+        t.write_text(transform)
+        return SettingDictionary({
+            "datax.job.name": "StrCkpt",
+            "datax.job.input.default.blobschemafile": str_schema,
+            "datax.job.process.transform": str(t),
+            "datax.job.process.timestampcolumn": "eventTimeStamp",
+            "datax.job.process.watermark": "0 second",
+            "datax.job.process.batchcapacity": "16",
+            "datax.job.process.timewindow.DataXProcessedInput_10seconds"
+            ".windowduration": "10 seconds",
+        })
+
+    ckpt = WindowStateCheckpointer(str(tmp_path / "ckpt"))
+    proc1 = FlowProcessor(conf("a"), output_datasets=["BySite"])
+    rows = [{"site": s, "eventTimeStamp": BASE} for s in
+            ["sea", "sea", "ams"]]
+    proc1.process_batch(proc1.encode_rows(rows, BASE), BASE)
+    ckpt.save(proc1.snapshot_window_state())
+    del proc1
+
+    proc2 = FlowProcessor(conf("b"), output_datasets=["BySite"])
+    assert proc2.restore_window_state(ckpt.load())
+    datasets, _ = proc2.process_batch(
+        proc2.encode_rows(
+            [{"site": "sea", "eventTimeStamp": BASE + 3000}], BASE + 3000
+        ),
+        BASE + 3000,
+    )
+    agg = {r["site"]: r["Cnt"] for r in datasets["BySite"]}
+    assert agg == {"sea": 3, "ams": 1}
+
+
 def test_window_snapshot_rejected_on_shape_change(tmp_path):
     ckpt = WindowStateCheckpointer(str(tmp_path / "ckpt"))
     proc1 = FlowProcessor(_winagg_conf(tmp_path / "a"),
