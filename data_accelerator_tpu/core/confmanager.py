@@ -97,13 +97,13 @@ class ConfigManager:
             raise EngineException(
                 "non-conf file is not supported as configuration input"
             )
-        if path.startswith("objstore://"):
+        from ..serve.objectstore import fetch_objstore_url, is_objstore_url
+
+        if is_objstore_url(path):
             # conf generated into the shared object store by the control
             # plane (serve/storage.py ObjectRuntimeStorage) — workers on
             # any host read it through the store, the role wasbs:// blob
             # paths play for the reference's cluster jobs
-            from ..serve.objectstore import fetch_objstore_url
-
             text = fetch_objstore_url(
                 path, token=os.environ.get("DATAX_OBJSTORE_TOKEN")
             )

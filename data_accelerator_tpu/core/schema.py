@@ -216,12 +216,18 @@ class StringDictionary:
         The current contents (strings encoded during flow compile) must
         be a prefix of the saved list — same conf produces the same
         compile-time encodes in the same order — otherwise the saved ids
-        would alias different strings and the restore is refused."""
+        would alias different strings and the restore is refused.
+
+        Replay bypasses the capacity bound: these entries ARE prior
+        state (device rings reference their ids), so an operator who
+        lowered ``maxsize`` below the saved size must still get an exact
+        restore — the bound applies to NEW strings only."""
         current = self._to_str[1:]
         if current != saved[: len(current)]:
             return False
         for s in saved[len(current):]:
-            self.encode(s)
+            self._to_id[s] = len(self._to_str)
+            self._to_str.append(s)
         return True
 
     def lookup(self, s: Optional[str]) -> int:

@@ -76,7 +76,7 @@ def _read_maybe_file(value: str) -> str:
     v = value.strip()
     if v.startswith("{") or v.startswith("[") or "\n" in v or "--" in v[:4]:
         return value
-    if v.startswith("objstore://"):
+    if v.startswith("objstore://") or v.startswith("objstore+https://"):
         from ..utils.fs import read_text
 
         return read_text(v)
@@ -293,6 +293,25 @@ class FlowProcessor:
 
         self.timestamp_column = process_conf.get("timestampcolumn")
         self.watermark_s = process_conf.get_duration_option("watermark") or 0.0
+
+        # per-row Properties map (reference: handler/PropertiesHandler.scala
+        # — appendproperty.* conf entries + BatchTime/InputTime/Partition/
+        # CPTime/CPExecutor per row). Conf-gated: encoding per-batch
+        # strings costs a dictionary entry per batch second, so flows opt
+        # in by declaring appendproperty.* keys or
+        # process.properties.enabled=true; otherwise the column stays
+        # NULL. SystemProperties stays NULL — it carries AMQP transport
+        # metadata the TCP/Kafka ingest paths do not have.
+        self.append_properties = dict(
+            process_conf.get_sub_dictionary("appendproperty.").dict
+        )
+        self.properties_enabled = bool(self.append_properties) or (
+            process_conf.get_or_else("properties.enabled", "false") or ""
+        ).lower() == "true"
+        self._props_cache: Dict[Tuple, int] = {}
+        import socket as _socket
+
+        self._executor_id = f"{_socket.gethostname()}:{os.getpid()}"
 
         # planner capacities are flow conf, not constants: maxgroups
         # bounds GROUP BY fan-out, joincapacity bounds join output rows
@@ -607,6 +626,12 @@ class FlowProcessor:
         self.ingest_stats: Dict[str, int] = {}
         self._native_decoders: Dict[str, object] = {}
 
+    def reset_state(self) -> None:
+        """Zero device state (rings, slot counter, time base; state
+        tables reload from their location). For re-entrant uses like
+        LiveQuery kernels where each execute must be idempotent."""
+        self._init_device_state()
+
     # -- window-state checkpoint ------------------------------------------
     def snapshot_window_state(self) -> Dict[str, object]:
         """Host copy of everything a restart would otherwise lose: the
@@ -801,6 +826,39 @@ class FlowProcessor:
     def _spec(self, source: Optional[str]) -> SourceSpec:
         return self.specs[source or self.primary]
 
+    def _properties_id(self, base_ms: int, file_info: Optional[dict] = None) -> int:
+        """Dictionary id of the per-row Properties JSON map (reference:
+        PropertiesHandler's per-row UDF result). Cached per (batch
+        second, file) so repeated rows share one dictionary entry."""
+        import datetime as _dt
+
+        key = (base_ms, file_info.get("path") if file_info else None)
+        sid = self._props_cache.get(key)
+        if sid is not None:
+            return sid
+
+        def iso(ms: int) -> str:
+            return _dt.datetime.fromtimestamp(
+                ms / 1000, _dt.timezone.utc
+            ).strftime("%Y-%m-%d %H:%M:%S")
+
+        from ..constants import ProcessingPropertyName as P
+
+        props = dict(self.append_properties)
+        props[P.BatchTime] = iso(base_ms)
+        props[P.CPTime] = iso(int(time.time()) * 1000)
+        props[P.CPExecutor] = self._executor_id
+        if file_info:
+            if file_info.get("fileTimeMs"):
+                props[P.BlobTime] = iso(int(file_info["fileTimeMs"]))
+            if file_info.get("path"):
+                props[P.BlobPathHint] = os.path.basename(file_info["path"])
+        sid = self.dictionary.encode(json.dumps(props, sort_keys=True))
+        if len(self._props_cache) > 4096:
+            self._props_cache.clear()
+        self._props_cache[key] = sid
+        return sid
+
     def encode_rows(
         self, rows: List[dict], base_ms: int, source: Optional[str] = None
     ) -> TableData:
@@ -815,6 +873,15 @@ class FlowProcessor:
             base_ms, stats=self.ingest_stats,
         )
         cols = dict(b.columns)
+        if self.properties_enabled:
+            default_id = self._properties_id(base_ms)
+            props = np.full(spec.capacity, 0, np.int32)
+            for i in range(min(len(rows), spec.capacity)):
+                fi = rows[i].get(ColumnName.InternalColumnFileInfo)
+                props[i] = (
+                    self._properties_id(base_ms, fi) if fi else default_id
+                )
+            cols[ColumnName.RawPropertiesColumn] = jnp.asarray(props)
         cols.setdefault(
             ColumnName.RawPropertiesColumn,
             jnp.zeros((spec.capacity,), jnp.int32),
@@ -895,7 +962,15 @@ class FlowProcessor:
             ColumnName.RawSystemPropertiesColumn,
         ):
             if extra in spec.raw_schema.types and extra not in np_cols:
-                np_cols[extra] = np.zeros(cap, np.int32)
+                if (
+                    extra == ColumnName.RawPropertiesColumn
+                    and self.properties_enabled
+                ):
+                    np_cols[extra] = np.full(
+                        cap, self._properties_id(base_ms), np.int32
+                    )
+                else:
+                    np_cols[extra] = np.zeros(cap, np.int32)
         if packed:
             return pack_raw(np_cols, np.asarray(valid))
         return TableData(
@@ -917,6 +992,14 @@ class FlowProcessor:
                 pad = np.zeros(cap, dtype=a.dtype)
                 pad[: min(n, cap)] = a[: min(n, cap)]
                 cols[c] = jnp.asarray(pad)
+            elif (
+                c == ColumnName.RawPropertiesColumn and self.properties_enabled
+            ):
+                cols[c] = jnp.full(
+                    (cap,),
+                    self._properties_id(int(time.time()) * 1000),
+                    jnp.int32,
+                )
             else:
                 cols[c] = jnp.zeros((cap,), fill_dtype.get(t, jnp.int32))
         valid = np.zeros(cap, dtype=bool)

@@ -224,6 +224,16 @@ class K8sJobClient(TpuJobClient):
         ).strip("-")
         return f"dxtpu-job-{safe}"
 
+    @staticmethod
+    def _label_safe(value: str) -> str:
+        """k8s label-value charset ([A-Za-z0-9._-], alnum ends, <=63) —
+        also what makes the raw-text FLOWNAME/JOBNAME substitution safe
+        against YAML metacharacters in user-authored flow names."""
+        safe = "".join(
+            c if c.isalnum() or c in "._-" else "-" for c in value
+        )[:63]
+        return safe.strip("._-") or "flow"
+
     def render_manifest(self, job: dict) -> dict:
         """deploy/k8s/tpu-job.yaml with FLOWNAME/JOBNAME substituted —
         the manifest IS the submission payload (no drift between the
@@ -232,11 +242,15 @@ class K8sJobClient(TpuJobClient):
 
         with open(self.manifest_path, encoding="utf-8") as f:
             text = f.read()
-        flow = job.get("flowName") or job["name"]
-        text = text.replace("FLOWNAME", flow).replace("JOBNAME", job["name"])
+        flow = self._label_safe(job.get("flowName") or job["name"])
+        text = text.replace("FLOWNAME", flow).replace(
+            "JOBNAME", self._label_safe(job["name"])
+        )
         manifest = yaml.safe_load(text)
         manifest["metadata"]["name"] = self._k8s_name(job)
-        manifest["metadata"].setdefault("labels", {})["job"] = job["name"]
+        manifest["metadata"].setdefault("labels", {})["job"] = (
+            self._label_safe(job["name"])
+        )
         container = manifest["spec"]["template"]["spec"]["containers"][0]
         container["image"] = self.image
         if job.get("confPath"):
@@ -311,16 +325,22 @@ class K8sJobClient(TpuJobClient):
         if status != 200:
             raise RuntimeError(f"k8s job get failed ({status})")
         s = body.get("status", {}) or {}
-        spec = body.get("spec", {}) or {}
+        # the Job controller's conditions are the authoritative terminal
+        # signal: a crash-looping pod under restartPolicy OnFailure may
+        # exhaust retries without status.failed ever exceeding
+        # backoffLimit, so counting alone never surfaces the failure
+        for cond in s.get("conditions") or []:
+            if str(cond.get("status")).lower() != "true":
+                continue
+            if cond.get("type") == "Failed":
+                return JobState.Error
+            if cond.get("type") == "Complete":
+                return JobState.Success
         if s.get("active"):
             return JobState.Running
         if s.get("succeeded"):
             return JobState.Success
-        if s.get("failed", 0) > spec.get("backoffLimit", 0):
-            return JobState.Error
-        if s.get("failed"):
-            return JobState.Starting  # retrying within backoffLimit
-        return JobState.Starting  # created, pods not yet scheduled
+        return JobState.Starting  # created/retrying, not yet terminal
 
 
 def make_job_client(conf: Optional[dict] = None, log_dir: Optional[str] = None):

@@ -31,6 +31,14 @@ from ..core.config import SettingDictionary
 from ..compile.transform_parser import TransformParser
 
 _WINDOWED_TABLE_RE = re.compile(rf"\b{DatasetName.DataStreamProjection}_\w+\b")
+# production TIMEWINDOW table naming: <projection>_<N><unit>
+_WINDOW_NAME_RE = re.compile(
+    rf"\b{DatasetName.DataStreamProjection}_(\d+)([A-Za-z]+)\b"
+)
+_DURATION_UNITS = {
+    "second", "seconds", "minute", "minutes", "hour", "hours",
+    "day", "days", "millisecond", "milliseconds",
+}
 
 DEFAULT_MAX_ROWS = 100
 DEFAULT_KERNEL_TTL_S = 30 * 60
@@ -60,7 +68,8 @@ class Kernel:
     _processors: Dict[str, object] = field(default_factory=dict)
     _lock: threading.Lock = field(default_factory=threading.Lock)
 
-    def _conf(self, transform_text: str) -> SettingDictionary:
+    def _conf(self, transform_text: str, windows: Dict[str, str],
+              max_window_s: float) -> SettingDictionary:
         conf = {
             "datax.job.name": f"LiveQuery-{self.flow_name}",
             "datax.job.input.default.inputtype": "local",
@@ -68,14 +77,83 @@ class Kernel:
             "datax.job.process.transform": transform_text,
             "datax.job.process.projection": self.normalization,
         }
+        if windows:
+            conf.update(windows)
+            conf["datax.job.process.timestampcolumn"] = self._timestamp_column()
+            conf["datax.job.process.watermark"] = "0 second"
+            # the kernel runs ONE batch; sizing the interval to the max
+            # window keeps the ring at 2 slots instead of window/1s
+            conf["datax.job.input.default.streaming.intervalinseconds"] = str(
+                max(1, int(max_window_s))
+            )
         conf.update(self.refdata_conf)
         return SettingDictionary(conf)
 
-    def _rewrite_windowed(self, query: str) -> str:
-        """Windowed views over the sample alias to the full sample (the
-        kernel's sampled span IS the window; production windows come from
-        the runtime ring buffers)."""
-        return _WINDOWED_TABLE_RE.sub(DatasetName.DataStreamProjection, query)
+    def _timestamp_column(self) -> Optional[str]:
+        """The time axis windows evict against: the schema's first
+        TIMESTAMP column, else the alias a current_timestamp()
+        normalization line introduces. Cached — called per execute."""
+        if not hasattr(self, "_ts_col"):
+            from ..core.schema import ColType, Schema
+
+            col = None
+            try:
+                schema = Schema.from_spark_json(self.schema_json)
+                for c in schema.columns:
+                    if c.ctype == ColType.TIMESTAMP:
+                        col = c.name
+                        break
+            except (ValueError, KeyError):
+                pass
+            if col is None:
+                m = re.search(
+                    r"current_timestamp\(\)\s+AS\s+(\w+)",
+                    self.normalization, re.I,
+                )
+                col = m.group(1) if m else None
+            self._ts_col = col
+        return self._ts_col
+
+    def _window_confs(self, query: str):
+        """TIMEWINDOW conf entries for every windowed table the query
+        names, parsed from the production ``<projection>_<N><unit>``
+        naming — so the kernel runs the SAME ring-buffer/watermark
+        window machinery as the production engine
+        (reference's same-engine promise, KernelService.cs:104-130),
+        with the sample's own time axis deciding what's in-window."""
+        if self._timestamp_column() is None:
+            return {}, 0.0
+        confs: Dict[str, str] = {}
+        max_s = 0.0
+        for n, unit in set(_WINDOW_NAME_RE.findall(query)):
+            if unit.lower() not in _DURATION_UNITS:
+                continue
+            name = f"{DatasetName.DataStreamProjection}_{n}{unit}"
+            confs[
+                f"datax.job.process.timewindow.{name}.windowduration"
+            ] = f"{n} {unit}"
+            scale = {
+                "millisecond": 0.001, "second": 1, "minute": 60,
+                "hour": 3600, "day": 86400,
+            }[unit.lower().rstrip("s")]
+            max_s = max(max_s, int(n) * scale)
+        return confs, max_s
+
+    def _rewrite_windowed(self, query: str, windows: Dict[str, str]) -> str:
+        """Windowed tables the production naming does NOT cover (no
+        parseable duration) alias to the full sample as a fallback;
+        properly-named ones run the real TIMEWINDOW machinery via
+        ``_window_confs``."""
+        real = {
+            key.split(".timewindow.", 1)[1].rsplit(".", 1)[0]
+            for key in windows
+        }
+        return _WINDOWED_TABLE_RE.sub(
+            lambda m: m.group(0)
+            if m.group(0) in real
+            else DatasetName.DataStreamProjection,
+            query,
+        )
 
     def _sample_base_ms(self) -> int:
         """The sample's own epoch-ms origin: the max value of the
@@ -107,7 +185,8 @@ class Kernel:
         from ..runtime.processor import FlowProcessor
 
         self.last_used = time.time()
-        text = self._rewrite_windowed(query.strip())
+        windows, max_window_s = self._window_confs(query)
+        text = self._rewrite_windowed(query.strip(), windows)
         if not text:
             return {"headers": [], "result": []}
 
@@ -124,12 +203,16 @@ class Kernel:
             proc = self._processors.get(text)
             if proc is None:
                 proc = FlowProcessor(
-                    self._conf(text),
+                    self._conf(text, windows, max_window_s),
                     batch_capacity=_capacity_for(len(self.sample_rows)),
                     output_datasets=[target],
                     udfs=self.udfs,
                 )
                 self._processors[text] = proc
+            else:
+                # a cached processor holds ring/state from its last run;
+                # kernel executes are idempotent, so start clean
+                proc.reset_state()
 
         # anchor the batch at the SAMPLE's time base, not the wall
         # clock: sampled blobs may be hours/days old and relative int32

@@ -51,6 +51,18 @@ class ObjectStoreClient:
         http: Optional[Transport] = None,
     ):
         self.endpoint = endpoint.rstrip("/")
+        parsed = urllib.parse.urlparse(self.endpoint)
+        if parsed.scheme not in ("http", "https"):
+            raise ValueError(
+                f"object-store endpoint must be http(s)://, got {endpoint!r}"
+            )
+        if parsed.path:
+            # objstore:// URLs partition as host/bucket/key; a path
+            # component would be swallowed as the bucket — fail loud
+            raise ValueError(
+                "object-store endpoint must not carry a path component "
+                f"(got {endpoint!r}); buckets name the top level"
+            )
         self.bucket = bucket
         self.token = token
         self._http = http or self._urllib_http
@@ -112,9 +124,11 @@ class ObjectStoreClient:
 
     def url_for(self, key: str) -> str:
         """objstore:// URL a worker can resolve back through this
-        protocol (core/confmanager.py fetch_objstore_url)."""
-        host = self.endpoint.split("://", 1)[-1]
-        return f"objstore://{host}/{self.bucket}/{key}"
+        protocol (utils/fs.read_text -> fetch_objstore_url). TLS
+        endpoints keep their scheme via the objstore+https:// form."""
+        scheme, host = self.endpoint.split("://", 1)
+        prefix = "objstore+https" if scheme == "https" else "objstore"
+        return f"{prefix}://{host}/{self.bucket}/{key}"
 
 
 _SAFE_KEY_RE = re.compile(r"^[\w\-./ %]+$")
@@ -297,13 +311,25 @@ class ObjectStoreServer(ThreadingHTTPServer):
         return sorted(out)
 
 
+def is_objstore_url(path: str) -> bool:
+    return path.startswith("objstore://") or path.startswith(
+        "objstore+https://"
+    )
+
+
 def fetch_objstore_url(url: str, token: Optional[str] = None) -> str:
-    """Resolve an ``objstore://host:port/bucket/key`` URL to text —
-    how engine workers read configs the control plane stored remotely."""
-    rest = url[len("objstore://"):]
+    """Resolve an ``objstore://host:port/bucket/key`` (or
+    ``objstore+https://``) URL to text — how engine workers read
+    configs the control plane stored remotely."""
+    if url.startswith("objstore+https://"):
+        scheme, rest = "https", url[len("objstore+https://"):]
+    elif url.startswith("objstore://"):
+        scheme, rest = "http", url[len("objstore://"):]
+    else:
+        raise ValueError(f"not an objstore URL: {url!r}")
     host, _, bucket_key = rest.partition("/")
     bucket, _, key = bucket_key.partition("/")
-    client = ObjectStoreClient(f"http://{host}", bucket, token=token)
+    client = ObjectStoreClient(f"{scheme}://{host}", bucket, token=token)
     data = client.get(key)
     if data is None:
         raise FileNotFoundError(url)

@@ -242,7 +242,9 @@ class ObjectRuntimeStorage(RuntimeStorage):
         return data.decode()
 
     def exists(self, path: str) -> bool:
-        return self.client.get(self._key(path)) is not None
+        # membership via the key listing — no object-body download
+        key = self._key(path)
+        return key in self.client.list(key)
 
     def list_files(self, prefix: str) -> List[str]:
         # directory semantics like the local backend: an exact-key file,
@@ -251,7 +253,7 @@ class ObjectRuntimeStorage(RuntimeStorage):
         n = len(self.PREFIX)
         key = self._key(prefix)
         out = []
-        if prefix and self.client.get(key) is not None:
+        if prefix and key in self.client.list(key):
             out.append(prefix)
         term = key.rstrip("/") + "/" if prefix else self.PREFIX
         out.extend(k[n:] for k in self.client.list(term))

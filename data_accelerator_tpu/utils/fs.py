@@ -43,10 +43,10 @@ def read_text(path: str) -> str:
     wasbs/abfs/local URIs in one place): ``objstore://`` URLs fetch from
     the shared object store, so any engine conf value may point at a
     file the control plane stored remotely."""
-    if path.startswith("objstore://"):
-        import os as _os
+    from ..serve.objectstore import fetch_objstore_url, is_objstore_url
 
-        from ..serve.objectstore import fetch_objstore_url
+    if is_objstore_url(path):
+        import os as _os
 
         return fetch_objstore_url(
             path, token=_os.environ.get("DATAX_OBJSTORE_TOKEN")

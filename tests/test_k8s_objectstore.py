@@ -110,10 +110,23 @@ class TestK8sJobClient:
         assert client.get_state(job) == JobState.Running
         fake.status[k] = {"succeeded": 1}
         assert client.get_state(job) == JobState.Success
-        fake.status[k] = {"failed": 2}  # within backoffLimit: retrying
+        # retrying within backoffLimit: failed pods but no terminal
+        # condition yet
+        fake.status[k] = {"failed": 2}
         assert client.get_state(job) == JobState.Starting
-        fake.status[k] = {"failed": 5}  # beyond backoffLimit
+        # the Job controller's conditions are the terminal authority
+        # (failure counts under restartPolicy OnFailure may never exceed
+        # backoffLimit)
+        fake.status[k] = {
+            "failed": 3,
+            "conditions": [{"type": "Failed", "status": "True"}],
+        }
         assert client.get_state(job) == JobState.Error
+        fake.status[k] = {
+            "active": 1,  # stale count races the condition: condition wins
+            "conditions": [{"type": "Complete", "status": "True"}],
+        }
+        assert client.get_state(job) == JobState.Success
 
     def test_stop_deletes_job(self, k8s):
         fake, client = k8s

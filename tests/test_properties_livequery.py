@@ -1,0 +1,154 @@
+"""Per-row Properties population (reference:
+handler/PropertiesHandler.scala) and LiveQuery TIMEWINDOW parity with
+the production engine (reference: KernelService.cs:104-130 — same
+engine, same semantics)."""
+
+import json
+
+import pytest
+
+from data_accelerator_tpu.core.config import SettingDictionary
+from data_accelerator_tpu.runtime.processor import FlowProcessor
+from data_accelerator_tpu.serve.livequery import KernelService
+
+SCHEMA = json.dumps({"type": "struct", "fields": [
+    {"name": "deviceId", "type": "long", "nullable": False, "metadata": {}},
+    {"name": "temperature", "type": "double", "nullable": False, "metadata": {}},
+    {"name": "eventTimeStamp", "type": "timestamp", "nullable": False,
+     "metadata": {}},
+]})
+
+BASE = 1_700_000_000_000
+
+
+def _proc(tmp_path, extra=None, transform=None):
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    t = tmp_path / "t.transform"
+    t.write_text(transform or (
+        "--DataXQuery--\n"
+        "Out = SELECT deviceId, Properties FROM DataXProcessedInput\n"
+    ))
+    d = {
+        "datax.job.name": "PropsFlow",
+        "datax.job.input.default.blobschemafile": SCHEMA,
+        "datax.job.process.transform": str(t),
+        "datax.job.process.timestampcolumn": "eventTimeStamp",
+        "datax.job.process.batchcapacity": "8",
+    }
+    d.update(extra or {})
+    return FlowProcessor(SettingDictionary(d), output_datasets=["Out"])
+
+
+def _rows(n=2, ts=BASE):
+    return [
+        {"deviceId": i, "temperature": 20.0, "eventTimeStamp": ts}
+        for i in range(n)
+    ]
+
+
+class TestProperties:
+    def test_append_properties_populate_per_row_map(self, tmp_path):
+        proc = _proc(tmp_path, {
+            "datax.job.process.appendproperty.env": "prod",
+            "datax.job.process.appendproperty.region": "eu",
+        })
+        datasets, _ = proc.process_batch(
+            proc.encode_rows(_rows(), BASE), BASE
+        )
+        props = json.loads(datasets["Out"][0]["Properties"])
+        assert props["env"] == "prod" and props["region"] == "eu"
+        assert props["BatchTime"].startswith("2023-11-14")
+        assert ":" in props["CPExecutor"]  # host:pid
+        assert "CPTime" in props
+
+    def test_blob_rows_carry_file_properties(self, tmp_path):
+        proc = _proc(tmp_path,
+                     {"datax.job.process.properties.enabled": "true"})
+        rows = _rows(2)
+        rows[0]["__DataX_FileInfo"] = {
+            "path": "/data/2023/11/14/part-0001.json",
+            "fileTimeMs": BASE - 60_000,
+        }
+        datasets, _ = proc.process_batch(proc.encode_rows(rows, BASE), BASE)
+        by_id = {r["deviceId"]: json.loads(r["Properties"])
+                 for r in datasets["Out"]}
+        assert by_id[0]["Partition"] == "part-0001.json"
+        assert by_id[0]["InputTime"].startswith("2023-11-14")
+        assert "Partition" not in by_id[1]
+        assert by_id[1]["BatchTime"] == by_id[0]["BatchTime"]
+
+    def test_properties_default_off_stays_null(self, tmp_path):
+        proc = _proc(tmp_path)
+        datasets, _ = proc.process_batch(
+            proc.encode_rows(_rows(), BASE), BASE
+        )
+        assert datasets["Out"][0]["Properties"] is None
+
+    def test_properties_on_columns_fast_path(self, tmp_path):
+        proc = _proc(tmp_path,
+                     {"datax.job.process.properties.enabled": "true"})
+        import numpy as np
+
+        raw = proc.encode_columns(
+            {"deviceId": np.arange(4, dtype=np.int32)}, 4
+        )
+        datasets, _ = proc.process_batch(raw)
+        props = json.loads(datasets["Out"][0]["Properties"])
+        assert "BatchTime" in props and "CPExecutor" in props
+
+
+class TestLiveQueryWindows:
+    def _kernel(self, rows):
+        svc = KernelService()
+        kid = svc.create_kernel(
+            "LQFlow", SCHEMA, normalization="Raw.*", sample_rows=rows
+        )
+        return svc, kid
+
+    def test_timewindow_honors_sample_time_axis(self):
+        """Rows older than the window relative to the sample's newest
+        timestamp are EXCLUDED — production ring semantics, not the old
+        whole-sample alias."""
+        rows = (
+            _rows(3, ts=BASE)               # in-window (t = base)
+            + _rows(2, ts=BASE - 8_000)     # 8 s old: outside 5 s window
+        )
+        svc, kid = self._kernel(rows)
+        out = svc.execute(
+            kid,
+            "W = SELECT COUNT(*) AS Cnt FROM DataXProcessedInput_5seconds",
+        )
+        assert out["result"][0]["Cnt"] == 3
+        # the un-windowed table still sees everything
+        out = svc.execute(
+            kid, "A = SELECT COUNT(*) AS Cnt FROM DataXProcessedInput"
+        )
+        assert out["result"][0]["Cnt"] == 5
+
+    def test_timewindow_minutes_unit(self):
+        rows = _rows(2, ts=BASE) + _rows(1, ts=BASE - 3 * 60_000)
+        svc, kid = self._kernel(rows)
+        out = svc.execute(
+            kid,
+            "W = SELECT COUNT(*) AS Cnt FROM DataXProcessedInput_2minutes",
+        )
+        assert out["result"][0]["Cnt"] == 2
+
+    def test_repeated_execute_is_idempotent(self):
+        """A cached query processor must not accumulate ring state
+        across executes."""
+        rows = _rows(3, ts=BASE)
+        svc, kid = self._kernel(rows)
+        q = "W = SELECT COUNT(*) AS Cnt FROM DataXProcessedInput_5seconds"
+        first = svc.execute(kid, q)["result"][0]["Cnt"]
+        second = svc.execute(kid, q)["result"][0]["Cnt"]
+        assert first == second == 3
+
+    def test_unparseable_window_name_falls_back_to_alias(self):
+        rows = _rows(2, ts=BASE) + _rows(1, ts=BASE - 60_000)
+        svc, kid = self._kernel(rows)
+        out = svc.execute(
+            kid,
+            "W = SELECT COUNT(*) AS Cnt FROM DataXProcessedInput_Window",
+        )
+        assert out["result"][0]["Cnt"] == 3  # whole sample
