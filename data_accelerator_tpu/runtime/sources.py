@@ -415,10 +415,12 @@ class KafkaSource(StreamingSource):
     ride the same OffsetCheckpointer as every other source, keyed
     (topic, partition).
 
-    The wire protocol client comes from ``confluent_kafka`` or
-    ``kafka-python`` when installed; in their absence construction
-    raises with a pointer at the SocketSource DCN path (the one-box
-    ingest). Message values must be JSON event bodies.
+    The protocol client comes from ``confluent_kafka`` or
+    ``kafka-python`` when installed; in their absence the built-in
+    dependency-free wire client takes over
+    (``runtime/kafka_wire.py`` — Metadata/ListOffsets/Fetch over raw
+    sockets, incl. the EventHub-compatible SASL PLAIN path). Message
+    values must be JSON event bodies.
     """
 
     def __init__(
@@ -428,6 +430,9 @@ class KafkaSource(StreamingSource):
         group_id: str = "dxtpu",
         name: str = "kafka",
         consumer=None,
+        security: Optional[str] = None,
+        username: Optional[str] = None,
+        password: Optional[str] = None,
     ):
         self.name = name
         self.topics = topics
@@ -444,24 +449,50 @@ class KafkaSource(StreamingSource):
             except ImportError:
                 try:
                     from kafka import KafkaConsumer  # type: ignore
-                except ImportError as e:
-                    raise RuntimeError(
-                        "kafka input requires confluent_kafka or "
-                        "kafka-python; for broker-less ingest use "
-                        "inputtype=socket (newline JSON over TCP)"
-                    ) from e
+                except ImportError:
+                    # no client library installed: the built-in wire
+                    # client speaks the Kafka protocol directly (incl.
+                    # the EventHub-compatible SASL_SSL path) —
+                    # runtime/kafka_wire.py
+                    from .kafka_wire import WireKafkaConsumer
+
+                    self._consumer = WireKafkaConsumer(
+                        brokers, topics, client_id=group_id,
+                        security=security, username=username,
+                        password=password,
+                    )
+                    self._flavor = "wire"
+                    return
+                kp_kwargs = {}
+                if security:
+                    kp_kwargs["security_protocol"] = security.upper()
+                    if security.lower().startswith("sasl"):
+                        kp_kwargs.update(
+                            sasl_mechanism="PLAIN",
+                            sasl_plain_username=username,
+                            sasl_plain_password=password,
+                        )
                 self._consumer = KafkaConsumer(
                     *topics, bootstrap_servers=brokers, group_id=group_id,
-                    enable_auto_commit=False,
+                    enable_auto_commit=False, **kp_kwargs,
                 )
                 self._flavor = "kafka-python"
                 return
-            c = Consumer({
+            conf = {
                 "bootstrap.servers": brokers,
                 "group.id": group_id,
                 "enable.auto.commit": False,
                 "auto.offset.reset": "earliest",
-            })
+            }
+            if security:
+                conf["security.protocol"] = security.upper()
+                if security.lower().startswith("sasl"):
+                    conf.update({
+                        "sasl.mechanism": "PLAIN",
+                        "sasl.username": username or "",
+                        "sasl.password": password or "",
+                    })
+            c = Consumer(conf)
             c.subscribe(topics)
             self._consumer = c
             self._flavor = "confluent"
@@ -671,13 +702,26 @@ def make_source(conf, schema: Schema, source: str = "default") -> StreamingSourc
     if input_type == "socket":
         port = conf.get_int_option("socket.port") or 0
         return SocketSource(port=port, name=nm("socket"))
-    if input_type == "kafka":
+    if input_type in ("kafka", "eventhub-kafka"):
+        # eventhub-kafka: EventHub through its Kafka-compatible endpoint
+        # (reference: KafkaStreamingFactory.scala:43-49 — SASL PLAIN,
+        # username $ConnectionString, password the connection string)
         topics = (conf.get("kafka.topics") or "").split(";")
+        username = conf.get("kafka.username")
+        password = conf.get("kafka.password")
+        security = conf.get("kafka.security")
+        if input_type == "eventhub-kafka":
+            security = security or "sasl_ssl"
+            username = username or "$ConnectionString"
+            password = password or conf.get("eventhub.connectionstring")
         return KafkaSource(
             conf.get_or_else("kafka.bootstrapservers", "localhost:9092"),
             [t for t in topics if t],
             group_id=conf.get_or_else("kafka.groupid", nm("dxtpu")),
             name=nm("kafka"),
+            security=security,
+            username=username,
+            password=password,
         )
     if input_type == "blobpointer":
         # pointer events arrive over socket or from a pointer file

@@ -112,7 +112,8 @@ route("#/flows", async (view) => {
 });
 
 /* ---------------- flow designer (datax-pipeline) ---------------- */
-const TABS = ["info", "input", "query", "rules", "outputs", "scale", "schedule"];
+const TABS = ["info", "input", "query", "rules", "functions", "outputs",
+              "scale", "schedule"];
 
 route("#/flow/", async (view, hash) => {
   const [, , name, tab = "info"] = hash.split("/");
@@ -202,21 +203,79 @@ route("#/flow/", async (view, hash) => {
     pane.append(h("div", { class: "muted" },
       "--DataXQuery-- blocks; TIMEWINDOW('5 minutes'); OUTPUT t TO sink;"));
   } else if (tab === "rules") {
+    const AGG_FNS = ["AVG", "SUM", "COUNT", "MIN", "MAX", "DCOUNT"];
+    // csv editor over a LIST-valued model key: displays joined, stores
+    // an array on change, and never mutates the model just by rendering
+    // (the backend contract is a list; a render must not turn it into a
+    // string that codegen would then iterate char-by-char)
+    const csvField = (obj, key, label, opts) => {
+      const disp = {
+        v: Array.isArray(obj[key]) ? obj[key].join(",") : (obj[key] || ""),
+      };
+      const f = field(disp, "v", label, opts);
+      $("input", f).addEventListener("change", (ev) => {
+        obj[key] = ev.target.value.split(",").map((x) => x.trim()).filter(Boolean);
+      });
+      return f;
+    };
     const list = h("div", {});
     const renderRules = () => {
       list.replaceChildren(...gui.rules.map((r, i) => {
         r.properties = r.properties || {};
         const p = r.properties;
-        if (Array.isArray(p._S_alertSinks)) p._S_alertSinks = p._S_alertSinks.join(",");
-        const sinksField = field(p, "_S_alertSinks", "Alert sinks (csv)", { ph: "Metrics" });
-        $("input", sinksField).addEventListener("change", (ev) => {
-          p._S_alertSinks = ev.target.value.split(",").map((x) => x.trim()).filter(Boolean);
-        });
-        return h("div", { class: "card" },
+        const sinksField = csvField(p, "_S_alertSinks", "Alert sinks (csv)", { ph: "Metrics" });
+        const typeField = field(p, "_S_ruleType", "Type",
+          { options: ["SimpleRule", "AggregateRule"] });
+        $("select", typeField).addEventListener("change", () => renderRules());
+        const card = h("div", { class: "card" },
           field(p, "_S_ruleDescription", "Description"),
-          field(p, "_S_ruleType", "Type", { options: ["SimpleRule", "AggregateRule"] }),
-          field(p, "_S_condition", "Condition (SQL expr)",
-            { ph: "deviceType = 'DoorLock' AND status = 0" }),
+          typeField);
+        if ((p._S_ruleType || "SimpleRule") === "AggregateRule") {
+          // pivot/agg builders (datax-pipeline AggregateRule editors):
+          // pivots are the GROUP BY columns; each agg row contributes
+          // "<FN>(<field>)" to $aggs, aliased FN_field for the condition
+          card.append(csvField(p, "_S_pivots",
+            "Pivot by (group-by columns, csv)", { ph: "deviceId, homeId" }));
+          if (!Array.isArray(p._S_aggs)) {
+            p._S_aggs = typeof p._S_aggs === "string" && p._S_aggs
+              ? p._S_aggs.split(",").map((x) => x.trim()) : [];
+          }
+          const aggList = h("div", {});
+          const renderAggs = () => {
+            aggList.replaceChildren(
+              ...p._S_aggs.map((agg, j) => {
+                const m = /^(\w+)\((.*)\)$/.exec(agg) || [null, "AVG", ""];
+                const fnSel = h("select", {}, AGG_FNS.map((o) =>
+                  h("option", { value: o, selected: o === m[1] ? "" : null }, o)));
+                const fieldIn = h("input", { value: m[2], placeholder: "temperature" });
+                const sync = () => {
+                  p._S_aggs[j] = `${fnSel.value}(${fieldIn.value.trim()})`;
+                };
+                fnSel.addEventListener("change", sync);
+                fieldIn.addEventListener("change", sync);
+                return h("div", { class: "row" }, fnSel, fieldIn,
+                  h("span", { class: "muted" },
+                    ` alias: ${(m[1] || "AVG")}_${(m[2] || "").replace(/\W/g, "_")}`),
+                  h("button", {
+                    class: "ghost danger",
+                    onclick: () => { p._S_aggs.splice(j, 1); renderAggs(); },
+                  }, "x"));
+              }),
+              h("button", {
+                class: "ghost",
+                onclick: () => { p._S_aggs.push("AVG()"); renderAggs(); },
+              }, "+ add aggregate"));
+          };
+          renderAggs();
+          card.append(h("label", { class: "f" },
+            h("span", {}, "Aggregates"), aggList));
+          card.append(field(p, "_S_condition", "Alert condition (over agg aliases)",
+            { ph: "AVG_temperature > 75" }));
+        } else {
+          card.append(field(p, "_S_condition", "Condition (SQL expr)",
+            { ph: "deviceType = 'DoorLock' AND status = 0" }));
+        }
+        card.append(
           sinksField,
           field(p, "_S_severity", "Severity", { options: ["Critical", "Medium", "Low"] }),
           field(p, "_S_isAlert", "Is alert", { options: ["", "true", "false"] }),
@@ -224,6 +283,7 @@ route("#/flow/", async (view, hash) => {
             class: "ghost danger",
             onclick: () => { gui.rules.splice(i, 1); renderRules(); },
           }, "remove rule"));
+        return card;
       }));
     };
     renderRules();
@@ -231,6 +291,50 @@ route("#/flow/", async (view, hash) => {
       class: "ghost",
       onclick: () => { gui.rules.push({ id: `rule${Date.now()}`, type: "Rule", properties: {} }); renderRules(); },
     }, "+ add rule"));
+  } else if (tab === "functions") {
+    // UDF / UDAF / external-function editor (datax-pipeline function
+    // editors); entries land in process.functions and S500 routes them
+    // to processJarUDFs / processJarUDAFs / processAzureFunctions
+    gui.process.functions = gui.process.functions || [];
+    const fns = gui.process.functions;
+    const list = h("div", {});
+    const renderFns = () => {
+      list.replaceChildren(...fns.map((f, i) => {
+        f.properties = f.properties || {};
+        const fp = f.properties;
+        const typeField = field(f, "type", "Kind",
+          { options: ["udf", "udaf", "azureFunction"] });
+        $("select", typeField).addEventListener("change", () => renderFns());
+        const card = h("div", { class: "card" },
+          field(f, "id", "Function name", { ph: "anomalyscore" }),
+          typeField);
+        if ((f.type || "udf") === "azureFunction") {
+          card.append(
+            field(fp, "serviceEndpoint", "Service endpoint", { ph: "https://fn.example" }),
+            field(fp, "api", "API name", { ph: "score" }),
+            field(fp, "code", "Function key/code"),
+            field(fp, "methodType", "Method", { options: ["get", "post"] }));
+        } else {
+          card.append(
+            field(fp, "module", "Python path (module:attribute)",
+              { ph: "data_accelerator_tpu.udf.samples:anomalyscore" }),
+            h("div", { class: "muted" },
+              (f.type || "udf") === "udaf"
+                ? "attribute must be/build a UdfAggregate (see udf/samples.py)"
+                : "attribute must be/build a jax-callable UDF (see udf/samples.py)"));
+        }
+        card.append(h("button", {
+          class: "ghost danger",
+          onclick: () => { fns.splice(i, 1); renderFns(); },
+        }, "remove function"));
+        return card;
+      }));
+    };
+    renderFns();
+    pane.append(list, h("button", {
+      class: "ghost",
+      onclick: () => { fns.push({ id: "", type: "udf", properties: {} }); renderFns(); },
+    }, "+ add function"));
   } else if (tab === "outputs") {
     const list = h("div", {});
     const renderOutputs = () => {

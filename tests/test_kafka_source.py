@@ -123,7 +123,9 @@ def test_kafka_ack_close():
     assert fc.closed
 
 
-def test_kafka_without_client_library_raises_helpfully(monkeypatch):
+def test_kafka_without_client_library_uses_wire_client(monkeypatch):
+    """No client library installed -> the built-in wire-protocol client
+    (runtime/kafka_wire.py) takes over instead of raising."""
     real_import = builtins.__import__
 
     def blocked(name, *a, **k):
@@ -132,8 +134,9 @@ def test_kafka_without_client_library_raises_helpfully(monkeypatch):
         return real_import(name, *a, **k)
 
     monkeypatch.setattr(builtins, "__import__", blocked)
-    with pytest.raises(RuntimeError, match="socket"):
-        KafkaSource("broker:9092", ["t1"])
+    src = KafkaSource("broker:9092", ["t1"])
+    assert src._flavor == "wire"
+    src.close()
 
 
 def test_make_source_kafka_conf(monkeypatch):

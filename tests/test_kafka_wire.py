@@ -1,0 +1,316 @@
+"""Wire-level Kafka ingest: the dependency-free protocol client
+(runtime/kafka_wire.py) against an in-process fake broker that serves
+REAL Kafka protocol bytes over a TCP socket — Metadata v1, ListOffsets
+v1, Fetch v4 with v2 record batches, and the EventHub-compatible SASL
+PLAIN handshake (reference: KafkaStreamingFactory.scala:23-70).
+"""
+
+import json
+import socket
+import struct
+import threading
+
+import pytest
+
+from data_accelerator_tpu.runtime.kafka_wire import (
+    API_FETCH,
+    API_LIST_OFFSETS,
+    API_METADATA,
+    API_SASL_HANDSHAKE,
+    Reader,
+    WireKafkaConsumer,
+    enc_array,
+    enc_i8,
+    enc_i16,
+    enc_i32,
+    enc_i64,
+    enc_str,
+    encode_record_batch,
+)
+from data_accelerator_tpu.runtime.sources import KafkaSource
+
+
+class FakeBroker:
+    """Single-node broker over a real socket. Topics: {name: {partition:
+    [value bytes, ...]}} — offsets are list indices."""
+
+    def __init__(self, topics, sasl=None, compressed=False):
+        self.topics = topics
+        self.sasl = sasl  # (user, pass) to require the PLAIN exchange
+        self.compressed = compressed
+        self.requests = []
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(4)
+        self.port = self._srv.getsockname()[1]
+        self._closing = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def close(self):
+        self._closing = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    # -- plumbing --------------------------------------------------------
+    def _accept_loop(self):
+        while not self._closing:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    @staticmethod
+    def _recv_n(conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        return buf
+
+    def _serve(self, conn):
+        authed = self.sasl is None
+        awaiting_token = False
+        try:
+            while True:
+                (size,) = struct.unpack(">i", self._recv_n(conn, 4))
+                payload = self._recv_n(conn, size)
+                if awaiting_token:
+                    # raw SASL PLAIN token: \0user\0pass
+                    _z, user, pw = payload.split(b"\0")
+                    if (user.decode(), pw.decode()) != self.sasl:
+                        conn.close()
+                        return
+                    authed = True
+                    awaiting_token = False
+                    conn.sendall(struct.pack(">i", 4) + b"\0\0\0\0")
+                    continue
+                r = Reader(payload)
+                api_key = r.i16()
+                r.i16()  # api version
+                corr = r.i32()
+                r.string()  # client id
+                self.requests.append(api_key)
+                if api_key == API_SASL_HANDSHAKE:
+                    body = enc_i16(0) + enc_array([enc_str("PLAIN")])
+                    awaiting_token = True
+                elif not authed:
+                    conn.close()
+                    return
+                elif api_key == API_METADATA:
+                    body = self._metadata()
+                elif api_key == API_LIST_OFFSETS:
+                    body = self._list_offsets(r)
+                elif api_key == API_FETCH:
+                    body = self._fetch(r)
+                else:
+                    conn.close()
+                    return
+                resp = enc_i32(corr) + body
+                conn.sendall(struct.pack(">i", len(resp)) + resp)
+        except (ConnectionError, OSError, struct.error):
+            pass
+
+    # -- api bodies ------------------------------------------------------
+    def _metadata(self):
+        brokers = enc_array([
+            enc_i32(0) + enc_str("127.0.0.1") + enc_i32(self.port)
+            + enc_str(None)
+        ])
+        topics = enc_array([
+            enc_i16(0) + enc_str(t) + enc_i8(0) + enc_array([
+                enc_i16(0) + enc_i32(p) + enc_i32(0)
+                + enc_array([enc_i32(0)]) + enc_array([enc_i32(0)])
+                for p in sorted(parts)
+            ])
+            for t, parts in self.topics.items()
+        ])
+        return brokers + enc_i32(0) + topics
+
+    def _list_offsets(self, r):
+        r.i32()  # replica
+        out_topics = []
+        for _ in range(r.i32()):
+            t = r.string()
+            parts = []
+            for _ in range(r.i32()):
+                p = r.i32()
+                ts = r.i64()
+                log = self.topics.get(t, {}).get(p, [])
+                off = len(log) if ts == -1 else 0
+                parts.append(
+                    enc_i32(p) + enc_i16(0) + enc_i64(-1) + enc_i64(off)
+                )
+            out_topics.append(enc_str(t) + enc_array(parts))
+        return enc_i32(0) + enc_array(out_topics)
+
+    def _fetch(self, r):
+        r.i32()  # replica
+        r.i32()  # max wait
+        r.i32()  # min bytes
+        r.i32()  # max bytes
+        r.i8()   # isolation
+        out_topics = []
+        for _ in range(r.i32()):
+            t = r.string()
+            parts = []
+            for _ in range(r.i32()):
+                p = r.i32()
+                pos = r.i64()
+                r.i32()  # partition max bytes
+                log = self.topics.get(t, {}).get(p, [])
+                if pos < len(log):
+                    records = encode_record_batch(pos, log[pos:])
+                    if self.compressed:
+                        # flip the compression bits in attributes (byte
+                        # offset: 8 base_offset + 4 len + 4 epoch +
+                        # 1 magic + 4 crc = 21)
+                        records = (
+                            records[:21]
+                            + struct.pack(">h", 1)  # gzip
+                            + records[23:]
+                        )
+                else:
+                    records = b""
+                parts.append(
+                    enc_i32(p) + enc_i16(0) + enc_i64(len(log))
+                    + enc_i64(len(log)) + enc_array([])
+                    + enc_i32(len(records)) + records
+                )
+            out_topics.append(enc_str(t) + enc_array(parts))
+        return enc_i32(0) + enc_array(out_topics)
+
+
+def _rows(tag, n):
+    return [
+        json.dumps({"tag": tag, "n": i}).encode() for i in range(n)
+    ]
+
+
+@pytest.fixture
+def broker():
+    b = FakeBroker({"events": {0: _rows("p0", 3), 1: _rows("p1", 2)}})
+    yield b
+    b.close()
+
+
+class TestWireConsumer:
+    def test_consume_all_partitions_over_socket(self, broker):
+        c = WireKafkaConsumer(f"127.0.0.1:{broker.port}", ["events"])
+        got = []
+        for _ in range(10):
+            m = c.poll(0.2)
+            if m is None:
+                break
+            got.append((m.topic(), m.partition(), m.offset(),
+                        json.loads(m.value())))
+        c.close()
+        assert len(got) == 5
+        p0 = [(o, v["n"]) for t, p, o, v in got if p == 0]
+        assert p0 == [(0, 0), (1, 1), (2, 2)]  # offsets line up
+        assert API_METADATA in broker.requests
+        assert API_LIST_OFFSETS in broker.requests
+        assert API_FETCH in broker.requests
+
+    def test_seek_skips_consumed(self, broker):
+        c = WireKafkaConsumer(f"127.0.0.1:{broker.port}", ["events"])
+        c.seek("events", 0, 2)
+        c.seek("events", 1, 2)  # past the end: nothing from p1
+        got = []
+        for _ in range(5):
+            m = c.poll(0.2)
+            if m is None:
+                break
+            got.append((m.partition(), m.offset()))
+        c.close()
+        assert got == [(0, 2)]
+
+    def test_sasl_plain_exchange(self):
+        b = FakeBroker(
+            {"t": {0: _rows("x", 1)}},
+            sasl=("$ConnectionString", "Endpoint=sb://ns/..."),
+        )
+        try:
+            c = WireKafkaConsumer(
+                f"127.0.0.1:{b.port}", ["t"],
+                security="sasl_plaintext",
+                username="$ConnectionString",
+                password="Endpoint=sb://ns/...",
+            )
+            m = c.poll(0.2)
+            assert m is not None and json.loads(m.value())["tag"] == "x"
+            c.close()
+            # wrong password: broker hangs up, poll degrades to None
+            bad = WireKafkaConsumer(
+                f"127.0.0.1:{b.port}", ["t"],
+                security="sasl_plaintext",
+                username="$ConnectionString", password="wrong",
+            )
+            assert bad.poll(0.2) is None
+            bad.close()
+        finally:
+            b.close()
+
+    def test_compressed_batches_fail_loud(self):
+        b = FakeBroker({"t": {0: _rows("x", 2)}}, compressed=True)
+        try:
+            c = WireKafkaConsumer(f"127.0.0.1:{b.port}", ["t"])
+            with pytest.raises(NotImplementedError, match="compressed"):
+                c.poll(0.2)
+            c.close()
+        finally:
+            b.close()
+
+
+class TestKafkaSourceOverWire:
+    def test_source_polls_through_wire_client(self, broker):
+        """No client library installed -> KafkaSource falls back to the
+        wire client; rows + offset ledger come from real protocol
+        bytes."""
+        src = KafkaSource(f"127.0.0.1:{broker.port}", ["events"])
+        assert src._flavor == "wire"
+        rows, offsets = src.poll(10)
+        src.ack()
+        src.close()
+        assert {r["tag"] for r in rows} == {"p0", "p1"}
+        assert offsets[("events", 0)] == (0, 3)
+        assert offsets[("events", 1)] == (0, 2)
+
+    def test_source_resumes_from_checkpoint_positions(self, broker):
+        src = KafkaSource(f"127.0.0.1:{broker.port}", ["events"])
+        src.start({("events", 0): 1, ("events", 1): 1})
+        rows, offsets = src.poll(10)
+        src.close()
+        assert offsets[("events", 0)] == (1, 3)
+        assert offsets[("events", 1)] == (1, 2)
+        assert len(rows) == 3
+
+    def test_make_source_eventhub_kafka_conf(self):
+        from data_accelerator_tpu.core.config import SettingDictionary
+        from data_accelerator_tpu.core.schema import Schema
+        from data_accelerator_tpu.runtime.sources import make_source
+
+        schema = Schema.from_spark_json(json.dumps({
+            "type": "struct",
+            "fields": [{"name": "n", "type": "long", "nullable": False,
+                        "metadata": {}}],
+        }))
+        conf = SettingDictionary({
+            "inputtype": "eventhub-kafka",
+            "kafka.bootstrapservers": "ns.servicebus.windows.net:9093",
+            "kafka.topics": "hub1",
+            "eventhub.connectionstring": "Endpoint=sb://ns/...",
+        })
+        src = make_source(conf, schema, source="default")
+        assert src._flavor == "wire"
+        assert src._consumer.security == "sasl_ssl"
+        assert src._consumer.username == "$ConnectionString"
+        assert src._consumer.password == "Endpoint=sb://ns/..."
+        src.close()
