@@ -547,7 +547,19 @@ class SelectCompiler:
                         env2 = EvalEnv(pl_scopes, base_s, now_rel_ms, li.shape)
                         return residual.fn(env2)
 
-                if j.kind == "LEFT":
+                if res_fn is None:
+                    # pure equi-join: sort-merge, O((n+m+cap) log) — the
+                    # path that keeps batch x windowed-table joins off
+                    # the O(n*m) match-matrix cliff
+                    from ..ops.join import sort_join_indices
+
+                    li, ri, valid, is_null, dropped = sort_join_indices(
+                        lkeys, rkeys, acc_valid, right.valid, out_cap,
+                        left_outer=(j.kind == "LEFT"),
+                    )
+                    if j.kind != "LEFT":
+                        is_null = None
+                elif j.kind == "LEFT":
                     li, ri, valid, is_null, dropped = left_join_indices(
                         lkeys, rkeys, acc_valid, right.valid, out_cap, res_fn
                     )

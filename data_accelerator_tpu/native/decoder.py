@@ -60,7 +60,7 @@ def _build_library() -> Optional[str]:
     ):
         return _LIB_PATH
     cmd = [
-        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
         "-o", _LIB_PATH, _SRC,
     ]
     try:
@@ -94,6 +94,12 @@ def _load():
             ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_int64),
         ]
+        lib.dx_decode_mt.restype = ctypes.c_int64
+        lib.dx_decode_mt.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+        ]
         lib.dx_bad_timestamps.restype = ctypes.c_int64
         lib.dx_bad_timestamps.argtypes = [ctypes.c_void_p]
         lib.dx_dict_size.restype = ctypes.c_int64
@@ -110,6 +116,19 @@ def _load():
 
 def native_available() -> bool:
     return _load() is not None
+
+
+def _decode_threads() -> int:
+    """Worker count for parallel decode (DATAX_DECODER_THREADS
+    overrides; default caps at 4 — ingest shares the host with the
+    engine loop and sinks)."""
+    env = os.environ.get("DATAX_DECODER_THREADS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return max(1, min(4, (os.cpu_count() or 1) - 1))
 
 
 class NativeDecoder:
@@ -183,7 +202,14 @@ class NativeDecoder:
     def decode(
         self, data: bytes, max_rows: int
     ) -> Tuple[Dict[str, np.ndarray], np.ndarray, int, int]:
-        """Returns (columns, valid, rows, bytes_consumed)."""
+        """Returns (columns, valid, rows, bytes_consumed).
+
+        ``valid`` is the ONLY authoritative row mask: on the parallel
+        path (payloads over ~1MB) malformed lines leave zeroed gap
+        slots at chunk tails, so valid rows are NOT a packed prefix and
+        ``arrays[:rows]`` would both drop real rows and include gaps.
+        ``rows`` is the decoded-row COUNT (== valid.sum()), for
+        metrics."""
         self._push_python_entries()
         arrays: Dict[str, np.ndarray] = {}
         ptrs = (ctypes.c_void_p * len(self._cols))()
@@ -193,9 +219,14 @@ class NativeDecoder:
             ptrs[i] = a.ctypes.data_as(ctypes.c_void_p)
         valid = np.zeros(max_rows, dtype=np.uint8)
         consumed = ctypes.c_int64(0)
-        rows = self._lib.dx_decode(
+        # parallel decode for big payloads: newline-chunked worker
+        # threads with a serial dictionary merge (decoder.cpp
+        # dx_decode_mt); small payloads stay on the single-thread path
+        n_threads = _decode_threads()
+        rows = self._lib.dx_decode_mt(
             self._d, data, len(data), max_rows, ptrs,
             valid.ctypes.data_as(ctypes.c_void_p), ctypes.byref(consumed),
+            n_threads,
         )
         self.last_bad_timestamps = int(self._lib.dx_bad_timestamps(self._d))
         self._pull_native_entries()

@@ -2,15 +2,24 @@
 
 Replaces Spark's shuffle-hash/broadcast join (implicit in spark.sql for
 the reference's JOIN queries, e.g. refdata joins in
-HomeAutomationLocal.json) with a static-shape pairwise-match formulation:
-build the [n, m] match matrix — an outer comparison the VPU chews through
-— then extract matching (left, right) index pairs with a fixed output
-capacity via ``jnp.nonzero(size=...)``.
+HomeAutomationLocal.json) with two static-shape formulations the
+planner chooses between per join site (shapes are static, so the
+choice is compile-time):
 
-This favors the flows' actual join shapes (small-to-medium right sides:
-reference data, per-window aggregates). For large-x-large joins the
-``parallel`` layer shards the left side across devices so each chip holds
-an [n/d, m] tile.
+- **sort-merge** (``sort_join_indices``, the default for pure equi
+  joins): dense group ids over the UNION of both sides' key tuples
+  (one lexsort), then searchsorted range lookup per left row and a
+  searchsorted-over-cumsum expansion into the fixed output capacity —
+  O((n+m+cap)·log). This is what keeps current-batch x windowed-table
+  joins (BASELINE config 3: 8k x 100k and beyond) off the O(n·m)
+  cliff.
+- **match-matrix** (``inner_join_indices``/``left_join_indices``):
+  the [n, m] outer comparison, kept for joins with non-equi residual
+  ON terms, which need the full pair mask anyway.
+
+Pair output order is identical between the two (left-major, right in
+original index order — stable sorts keep equal-gid rows in input
+order), so the planner can switch freely.
 """
 
 from __future__ import annotations
@@ -18,6 +27,8 @@ from __future__ import annotations
 from typing import Callable, Optional, Tuple
 
 import jax.numpy as jnp
+
+from .groupby import group_ids
 
 
 def inner_join_indices(
@@ -60,6 +71,70 @@ def inner_join_indices(
     left_idx = pair_idx // m
     right_idx = pair_idx % m
     return left_idx, right_idx, valid, dropped
+
+
+def _union_gids(left_keys, right_keys, left_valid, right_valid):
+    """Dense key-tuple ids across both sides: equal tuples (any mix of
+    key columns/types) get equal ids; invalid rows get per-side
+    sentinels that never match anything."""
+    keys = [
+        jnp.concatenate([lk, rk]) for lk, rk in zip(left_keys, right_keys)
+    ]
+    valid = jnp.concatenate([left_valid, right_valid])
+    order, seg, _num, _first = group_ids(keys, valid)
+    gid = jnp.zeros(valid.shape[0], jnp.int32).at[order].set(seg)
+    n = left_valid.shape[0]
+    gl = jnp.where(left_valid, gid[:n], -1)
+    gr = jnp.where(right_valid, gid[n:], -2)
+    return gl, gr
+
+
+def sort_join_indices(
+    left_keys,
+    right_keys,
+    left_valid: jnp.ndarray,
+    right_valid: jnp.ndarray,
+    out_capacity: int,
+    left_outer: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sort-merge equi-join (no residual support — the planner keeps
+    the match-matrix for those).
+
+    Returns (left_idx, right_idx, valid, right_is_null, dropped) —
+    the LEFT OUTER surface; for inner joins ``right_is_null`` is all
+    False. Per left row: its matching right rows occupy a contiguous
+    range of the gid-sorted right side, located with two searchsorteds;
+    output slots map back to (left row, offset) via a searchsorted over
+    the inclusive pair-count cumsum.
+    """
+    n = left_valid.shape[0]
+    m = right_valid.shape[0]
+    gl, gr = _union_gids(left_keys, right_keys, left_valid, right_valid)
+    r_order = jnp.argsort(gr, stable=True)
+    gr_s = gr[r_order]
+    lo = jnp.searchsorted(gr_s, gl, side="left")
+    hi = jnp.searchsorted(gr_s, gl, side="right")
+    matches = jnp.where(left_valid, hi - lo, 0)
+    if left_outer:
+        # unmatched valid left rows emit one null-right row
+        cnt = jnp.where(left_valid, jnp.maximum(matches, 1), 0)
+    else:
+        cnt = matches
+    cum = jnp.cumsum(cnt)
+    total = cum[-1]
+    starts = cum - cnt
+    j = jnp.arange(out_capacity)
+    li = jnp.searchsorted(cum, j, side="right")
+    valid_out = j < total
+    li_c = jnp.clip(li, 0, n - 1)
+    offset = j - starts[li_c]
+    is_null = left_outer & (matches[li_c] == 0) & valid_out
+    rpos = jnp.clip(lo[li_c] + offset, 0, m - 1)
+    ri = r_order[rpos]
+    dropped = jnp.maximum(total - jnp.int32(out_capacity), 0)
+    left_idx = jnp.where(valid_out, li_c, 0)
+    right_idx = jnp.where(valid_out & ~is_null, ri, 0)
+    return left_idx, right_idx, valid_out, is_null, dropped
 
 
 def left_join_indices(

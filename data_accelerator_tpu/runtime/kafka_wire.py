@@ -232,6 +232,10 @@ def decode_record_batches(data: bytes) -> List[Tuple[int, int, bytes]]:
                 continue
             body.u32()  # crc (trusted; TCP already checksums)
             attributes = body.i16()
+            if attributes & 0x20:
+                # control batch (transaction commit/abort markers):
+                # metadata, not data — real clients skip them
+                continue
             if attributes & 0x07:
                 raise NotImplementedError(
                     "compressed kafka record batches are not supported by "
@@ -460,7 +464,8 @@ class WireKafkaConsumer:
             + enc_array([enc_i32(partition) + enc_i64(ts)])
         ])
         r = self._request(s, API_LIST_OFFSETS, 1, body)
-        r.i32()  # throttle
+        # NOTE: v1 responses have NO throttle_time_ms (added in v2) —
+        # the topics array count comes first
         for _ in range(r.i32()):
             r.string()
             for _ in range(r.i32()):

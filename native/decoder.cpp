@@ -18,7 +18,12 @@
 //    string->int32 map shared (via sync calls) with the Python
 //    StringDictionary so device-side comparisons stay int32;
 //  - timestamps accept epoch seconds/millis or basic ISO-8601 Zulu and
-//    land as int64 millis (Python rebases to int32 batch-relative).
+//    land as int64 millis (Python rebases to int32 batch-relative);
+//  - dx_decode_mt parallelizes big payloads: newline-aligned chunks
+//    parse on worker threads into disjoint row-slot ranges, string
+//    misses intern thread-locally against the frozen shared dictionary,
+//    and a serial merge assigns global ids (the single-writer step is
+//    O(new distinct strings), not O(rows)).
 //
 // C ABI for ctypes; no external dependencies.
 
@@ -27,6 +32,7 @@
 #include <cstring>
 #include <ctime>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -200,9 +206,44 @@ int64_t parse_iso8601_ms(const std::string& s, bool* ok) {
   return epoch_s * 1000 + ms;
 }
 
+// String interning sink. Single-threaded decodes insert into the
+// decoder's dictionary directly (``direct``); parallel workers treat
+// the shared map as FROZEN (safe concurrent reads) and collect misses
+// in a thread-local map with provisional ids >= shared_size — the
+// merge pass after join() assigns global ids and rewrites only that
+// worker's row range, so provisional id spaces may overlap across
+// threads without ever colliding in the output.
+struct DictSink {
+  Decoder* direct = nullptr;
+  const std::unordered_map<std::string, int32_t>* shared = nullptr;
+  int32_t shared_size = 0;
+  std::unordered_map<std::string, int32_t> local;
+  std::vector<std::string> local_entries;
+
+  int32_t intern(const std::string& s) {
+    if (direct) {
+      auto it = direct->dict.find(s);
+      if (it != direct->dict.end()) return it->second;
+      int32_t id = (int32_t)direct->dict_entries.size();
+      direct->dict.emplace(s, id);
+      direct->dict_entries.push_back(s);
+      return id;
+    }
+    auto it = shared->find(s);
+    if (it != shared->end()) return it->second;
+    auto lt = local.find(s);
+    if (lt != local.end()) return lt->second;
+    int32_t id = shared_size + (int32_t)local_entries.size();
+    local.emplace(s, id);
+    local_entries.push_back(s);
+    return id;
+  }
+};
+
 struct ParseCtx {
   Decoder* d;
   OutBufs* out;
+  DictSink* dict;
   int64_t row;
   std::string path;      // reusable dotted-path buffer
   std::string sbuf;      // reusable string scratch
@@ -267,16 +308,8 @@ void store_scalar(ParseCtx& ctx, int32_t ci, Cursor& c) {
         skip_value(c);
         ctx.sbuf.assign(start, c.p - start);
       }
-      auto it = d->dict.find(ctx.sbuf);
-      int32_t id;
-      if (it == d->dict.end()) {
-        id = (int32_t)d->dict_entries.size();
-        d->dict.emplace(ctx.sbuf, id);
-        d->dict_entries.push_back(ctx.sbuf);
-      } else {
-        id = it->second;
-      }
-      static_cast<int32_t*>(o->col_ptrs[ci])[ctx.row] = id;
+      static_cast<int32_t*>(o->col_ptrs[ci])[ctx.row] =
+          ctx.dict->intern(ctx.sbuf);
       break;
     }
     case T_TS: {
@@ -387,6 +420,50 @@ void zero_row(Decoder* d, OutBufs* o, int64_t row) {
   }
 }
 
+// Decode newline-delimited lines in [start, end) into row slots
+// [row_base, row_base + budget); returns rows produced. Shared by the
+// single-threaded entry point and each parallel worker.
+int64_t decode_range(Decoder* d, OutBufs* out, DictSink* sink,
+                     const char* start, const char* end,
+                     int64_t row_base, int64_t budget,
+                     int64_t* bad_out, const char** consumed_to) {
+  ParseCtx ctx{d, out, sink, 0, std::string(), std::string()};
+  ctx.path.reserve(128);
+  ctx.sbuf.reserve(256);
+  const char* p = start;
+  const char* line_start = p;
+  int64_t rows = 0;
+  int64_t bad = 0;
+  while (p < end && rows < budget) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    const char* line_end = nl ? nl : end;
+    Cursor c{line_start, line_end};
+    skip_ws(c);
+    if (c.p < c.end && *c.p == '{') {
+      ctx.row = row_base + rows;
+      ctx.path.clear();
+      ctx.bad_ts = false;
+      if (parse_object(ctx, c) && !ctx.bad_ts) {
+        out->valid[row_base + rows] = 1;
+        ++rows;
+      } else {
+        if (ctx.bad_ts) ++bad;
+        zero_row(d, out, row_base + rows);
+      }
+    }
+    if (!nl) {
+      p = end;
+      line_start = end;
+      break;
+    }
+    p = nl + 1;
+    line_start = p;
+  }
+  if (bad_out) *bad_out = bad;
+  if (consumed_to) *consumed_to = line_start;
+  return rows;
+}
+
 }  // namespace
 
 extern "C" {
@@ -430,43 +507,127 @@ int64_t dx_decode(void* dv, const char* buf, int64_t len, int64_t max_rows,
                   void** col_ptrs, uint8_t* valid, int64_t* consumed) {
   Decoder* d = static_cast<Decoder*>(dv);
   OutBufs out{col_ptrs, valid, max_rows};
-  ParseCtx ctx{d, &out, 0, std::string(), std::string()};
-  ctx.path.reserve(128);
-  ctx.sbuf.reserve(256);
+  DictSink sink;
+  sink.direct = d;
+  int64_t bad = 0;
+  const char* consumed_to = buf;
+  int64_t rows = decode_range(d, &out, &sink, buf, buf + len, 0, max_rows,
+                              &bad, &consumed_to);
+  d->bad_ts_count = bad;
+  if (consumed) *consumed = consumed_to - buf;
+  return rows;
+}
 
-  const char* p = buf;
+// Parallel decode: newline-aligned byte chunks parse concurrently, each
+// into its own contiguous row-slot range (slot budget = the chunk's
+// line count, so ranges never overlap). String misses intern into
+// thread-local maps against the FROZEN shared dictionary and a serial
+// merge pass assigns global ids + rewrites each worker's string cells.
+// Falls back to the single-threaded path when the work is small, the
+// thread count is 1, or the buffer holds more lines than max_rows
+// (whole-buffer slot layout needs every line to have a slot).
+int64_t dx_decode_mt(void* dv, const char* buf, int64_t len,
+                     int64_t max_rows, void** col_ptrs, uint8_t* valid,
+                     int64_t* consumed, int32_t n_threads) {
+  Decoder* d = static_cast<Decoder*>(dv);
+  if (n_threads <= 1 || len < (1 << 20)) {
+    return dx_decode(dv, buf, len, max_rows, col_ptrs, valid, consumed);
+  }
   const char* end = buf + len;
-  const char* line_start = p;
-  int64_t rows = 0;
-  d->bad_ts_count = 0;
-  while (p < end && rows < max_rows) {
-    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
-    const char* line_end = nl ? nl : end;
-    Cursor c{line_start, line_end};
-    skip_ws(c);
-    if (c.p < c.end && *c.p == '{') {
-      ctx.row = rows;
-      ctx.path.clear();
-      ctx.bad_ts = false;
-      if (parse_object(ctx, c) && !ctx.bad_ts) {
-        valid[rows] = 1;
-        ++rows;
+  // chunk boundaries on newline edges
+  std::vector<const char*> bounds;
+  bounds.push_back(buf);
+  for (int32_t t = 1; t < n_threads; ++t) {
+    const char* target = buf + (len * t) / n_threads;
+    if (target <= bounds.back()) continue;
+    const char* nl = static_cast<const char*>(
+        memchr(target, '\n', end - target));
+    const char* b = nl ? nl + 1 : end;
+    if (b > bounds.back() && b < end) bounds.push_back(b);
+  }
+  bounds.push_back(end);
+  size_t nchunks = bounds.size() - 1;
+
+  // line counts -> disjoint row-slot ranges
+  std::vector<int64_t> lines(nchunks, 0);
+  int64_t total_lines = 0;
+  for (size_t k = 0; k < nchunks; ++k) {
+    const char* p = bounds[k];
+    while (p < bounds[k + 1]) {
+      const char* nl = static_cast<const char*>(
+          memchr(p, '\n', bounds[k + 1] - p));
+      ++lines[k];
+      if (!nl) break;
+      p = nl + 1;
+    }
+    total_lines += lines[k];
+  }
+  if (total_lines > max_rows) {
+    // a line without a slot would shift every later chunk's slots;
+    // bounded decodes take the sequential path
+    return dx_decode(dv, buf, len, max_rows, col_ptrs, valid, consumed);
+  }
+
+  OutBufs out{col_ptrs, valid, max_rows};
+  int32_t shared_size = (int32_t)d->dict_entries.size();
+  std::vector<DictSink> sinks(nchunks);
+  std::vector<int64_t> row_base(nchunks, 0), rows_k(nchunks, 0),
+      bad_k(nchunks, 0);
+  std::vector<const char*> consumed_k(nchunks);
+  for (size_t k = 1; k < nchunks; ++k) {
+    row_base[k] = row_base[k - 1] + lines[k - 1];
+  }
+  std::vector<std::thread> workers;
+  for (size_t k = 0; k < nchunks; ++k) {
+    sinks[k].shared = &d->dict;
+    sinks[k].shared_size = shared_size;
+    workers.emplace_back([&, k] {
+      rows_k[k] = decode_range(d, &out, &sinks[k], bounds[k],
+                               bounds[k + 1], row_base[k], lines[k],
+                               &bad_k[k], &consumed_k[k]);
+    });
+  }
+  for (auto& w : workers) w.join();
+
+  // serial merge: global ids for each worker's local entries, then
+  // rewrite that worker's provisional string cells (>= shared_size)
+  std::vector<size_t> str_cols;
+  for (size_t ci = 0; ci < d->cols.size(); ++ci) {
+    if (d->cols[ci].type == T_STR) str_cols.push_back(ci);
+  }
+  int64_t total_rows = 0;
+  int64_t total_bad = 0;
+  for (size_t k = 0; k < nchunks; ++k) {
+    total_rows += rows_k[k];
+    total_bad += bad_k[k];
+    if (str_cols.empty() || sinks[k].local_entries.empty()) continue;
+    std::vector<int32_t> remap(sinks[k].local_entries.size());
+    for (size_t j = 0; j < sinks[k].local_entries.size(); ++j) {
+      const std::string& s = sinks[k].local_entries[j];
+      auto it = d->dict.find(s);
+      if (it != d->dict.end()) {
+        remap[j] = it->second;
       } else {
-        if (ctx.bad_ts) ++d->bad_ts_count;
-        zero_row(d, &out, rows);
+        int32_t id = (int32_t)d->dict_entries.size();
+        d->dict.emplace(s, id);
+        d->dict_entries.push_back(s);
+        remap[j] = id;
       }
     }
-    if (!nl) {
-      // no trailing newline: consume to end
-      p = end;
-      line_start = end;
-      break;
+    for (size_t ci : str_cols) {
+      int32_t* cells = static_cast<int32_t*>(col_ptrs[ci]);
+      for (int64_t r = row_base[k]; r < row_base[k] + lines[k]; ++r) {
+        int32_t v = cells[r];
+        if (v >= shared_size &&
+            v - shared_size < (int32_t)remap.size()) {
+          cells[r] = remap[v - shared_size];
+        }
+      }
     }
-    p = nl + 1;
-    line_start = p;
   }
-  if (consumed) *consumed = line_start - buf;
-  return rows;
+  d->bad_ts_count = total_bad;
+  if (consumed) *consumed = consumed_k[nchunks - 1] - buf;
+  return total_rows;
 }
 
 // Rows dropped by the last dx_decode because a string timestamp was

@@ -149,7 +149,8 @@ class FakeBroker:
                     enc_i32(p) + enc_i16(0) + enc_i64(-1) + enc_i64(off)
                 )
             out_topics.append(enc_str(t) + enc_array(parts))
-        return enc_i32(0) + enc_array(out_topics)
+        # v1: NO throttle_time_ms (that field arrived in v2)
+        return enc_array(out_topics)
 
     def _fetch(self, r):
         r.i32()  # replica
@@ -314,3 +315,17 @@ class TestKafkaSourceOverWire:
         assert src._consumer.username == "$ConnectionString"
         assert src._consumer.password == "Endpoint=sb://ns/..."
         src.close()
+
+
+def test_control_batches_skipped():
+    """Transaction markers (control batches, attributes bit 5) are
+    metadata, not data — they must not surface as messages."""
+    from data_accelerator_tpu.runtime.kafka_wire import decode_record_batches
+
+    data_batch = encode_record_batch(0, [b'{"n":1}'])
+    marker = bytearray(encode_record_batch(1, [b"\x00\x00\x00\x01"]))
+    # set isControl (bit 5) in attributes at offset 21 (8 base_offset +
+    # 4 len + 4 epoch + 1 magic + 4 crc)
+    marker[21:23] = struct.pack(">h", 0x20)
+    records = decode_record_batches(bytes(data_batch) + bytes(marker))
+    assert [(o, v) for o, _ts, v in records] == [(0, b'{"n":1}')]

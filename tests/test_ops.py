@@ -168,3 +168,46 @@ def test_ops_jit_compatible():
     )
     assert int(num) == 2
     np.testing.assert_allclose(np.asarray(out[:2]), [3.0, 3.0])
+
+
+def test_sort_join_matches_matrix_join():
+    """Sort-merge and match-matrix joins agree pair-for-pair (values,
+    validity, drop count, and ORDER) on random multi-key data."""
+    from data_accelerator_tpu.ops.join import sort_join_indices
+
+    rng = np.random.RandomState(5)
+    n, m, cap = 64, 48, 256
+    lk1 = jnp.asarray(rng.randint(0, 8, n), jnp.int32)
+    lk2 = jnp.asarray(rng.randint(0, 3, n), jnp.int32)
+    rk1 = jnp.asarray(rng.randint(0, 8, m), jnp.int32)
+    rk2 = jnp.asarray(rng.randint(0, 3, m), jnp.int32)
+    lv = jnp.asarray(rng.rand(n) > 0.2)
+    rv = jnp.asarray(rng.rand(m) > 0.2)
+
+    li_a, ri_a, va, da = inner_join_indices([lk1, lk2], [rk1, rk2], lv, rv, cap)
+    li_b, ri_b, vb, nb, db = sort_join_indices([lk1, lk2], [rk1, rk2], lv, rv, cap)
+    pa = [(int(li_a[i]), int(ri_a[i])) for i in range(cap) if bool(va[i])]
+    pb = [(int(li_b[i]), int(ri_b[i])) for i in range(cap) if bool(vb[i])]
+    assert pa == pb  # identical pairs in identical order
+    assert int(da) == int(db) == 0
+    assert not bool(np.asarray(nb).any())
+
+
+def test_sort_join_overflow_and_left_outer():
+    from data_accelerator_tpu.ops.join import sort_join_indices
+
+    lk = jnp.asarray([1, 1, 2, 3], jnp.int32)
+    rk = jnp.asarray([1, 1, 1, 9], jnp.int32)
+    lv = jnp.ones(4, bool)
+    rv = jnp.ones(4, bool)
+    # inner with overflow: 2 left rows x 3 matches = 6 pairs, cap 4
+    _, _, valid, _nul, dropped = sort_join_indices([lk], [rk], lv, rv, 4)
+    assert int(np.asarray(valid).sum()) == 4
+    assert int(dropped) == 2
+    # left outer: unmatched lefts (2, 3) emit one null row each
+    li, ri, valid, is_null, dropped = sort_join_indices(
+        [lk], [rk], lv, rv, 16, left_outer=True
+    )
+    rows = [(int(li[i]), bool(is_null[i])) for i in range(16) if bool(valid[i])]
+    assert rows == [(0, False)] * 3 + [(1, False)] * 3 + [(2, True), (3, True)]
+    assert int(dropped) == 0
