@@ -212,11 +212,21 @@ def _crc32c(data: bytes) -> int:
     return crc ^ 0xFFFFFFFF
 
 
-def decode_record_batches(data: bytes) -> List[Tuple[int, int, bytes]]:
-    """All (offset, timestamp_ms, value) records in a Fetch response's
-    records bytes (possibly several concatenated batches; a trailing
-    partial batch — normal at the fetch size boundary — is skipped)."""
+def decode_record_batches(
+    data: bytes,
+) -> Tuple[List[Tuple[int, int, bytes]], int]:
+    """(records, next_offset) from a Fetch response's records bytes
+    (possibly several concatenated batches; a trailing partial batch —
+    normal at the fetch size boundary — is skipped).
+
+    ``records``: (offset, timestamp_ms, value) per data record.
+    ``next_offset``: one past the last offset COVERED by any complete
+    batch, data or not (-1 when none) — the caller must advance its
+    fetch position with this, not just the last data record, or a
+    skipped control batch at the log tail would be refetched forever.
+    """
     out: List[Tuple[int, int, bytes]] = []
+    next_offset = -1
     r = Reader(data)
     while r.remaining() >= 61:  # minimal v2 batch header size
         try:
@@ -232,9 +242,14 @@ def decode_record_batches(data: bytes) -> List[Tuple[int, int, bytes]]:
                 continue
             body.u32()  # crc (trusted; TCP already checksums)
             attributes = body.i16()
+            last_offset_delta = body.i32()
+            next_offset = max(
+                next_offset, base_offset + last_offset_delta + 1
+            )
             if attributes & 0x20:
                 # control batch (transaction commit/abort markers):
-                # metadata, not data — real clients skip them
+                # metadata, not data — skipped, but next_offset above
+                # still advances past it
                 continue
             if attributes & 0x07:
                 raise NotImplementedError(
@@ -243,7 +258,6 @@ def decode_record_batches(data: bytes) -> List[Tuple[int, int, bytes]]:
                     "compression.type=uncompressed or install "
                     "confluent-kafka/kafka-python"
                 )
-            body.i32()  # lastOffsetDelta
             first_ts = body.i64()
             body.i64()  # maxTimestamp
             body.i64()  # producerId
@@ -266,7 +280,7 @@ def decode_record_batches(data: bytes) -> List[Tuple[int, int, bytes]]:
                 )
         except EOFError:
             break
-    return out
+    return out, next_offset
 
 
 # ---------------------------------------------------------------------------
@@ -546,17 +560,25 @@ class WireKafkaConsumer:
                             "kafka fetch error %d on %s/%d", err, tname, pidx
                         )
                         continue
+                    recs, next_off = decode_record_batches(records)
                     msgs = []
-                    for offset, _ts, value in decode_record_batches(records):
+                    for offset, _ts, value in recs:
                         if offset < self._positions[(tname, pidx)]:
                             continue  # batch may start before request pos
                         msgs.append(WireMessage(tname, pidx, offset, value))
                     if msgs:
                         with self._lock:
                             self._buffer.extend(msgs)
-                        self._positions[(tname, pidx)] = (
-                            msgs[-1].offset() + 1
-                        )
+                    # advance past EVERYTHING the fetch covered —
+                    # including skipped control batches, which would
+                    # otherwise be refetched in a hot loop forever
+                    pos_key = (tname, pidx)
+                    new_pos = max(
+                        next_off,
+                        (msgs[-1].offset() + 1) if msgs else -1,
+                    )
+                    if new_pos > self._positions[pos_key]:
+                        self._positions[pos_key] = new_pos
 
     def close(self) -> None:
         for s in self._socks.values():

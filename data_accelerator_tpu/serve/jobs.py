@@ -170,10 +170,16 @@ class K8sJobClient(TpuJobClient):
         manifest_path: Optional[str] = None,
         http=None,
         insecure: bool = False,
+        accelerator: Optional[str] = None,
+        topology: Optional[str] = None,
     ):
         self.api_server = api_server.rstrip("/")
         self.namespace = namespace
         self.image = image
+        # TPU placement overrides for the rendered Job (the template's
+        # nodeSelector values are the v5e defaults)
+        self.accelerator = accelerator
+        self.topology = topology
         self.manifest_path = manifest_path or os.path.join(
             os.path.dirname(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__)))),
@@ -251,7 +257,14 @@ class K8sJobClient(TpuJobClient):
         manifest["metadata"].setdefault("labels", {})["job"] = (
             self._label_safe(job["name"])
         )
-        container = manifest["spec"]["template"]["spec"]["containers"][0]
+        pod = manifest["spec"]["template"]["spec"]
+        if self.accelerator or self.topology:
+            sel = pod.setdefault("nodeSelector", {})
+            if self.accelerator:
+                sel["cloud.google.com/gke-tpu-accelerator"] = self.accelerator
+            if self.topology:
+                sel["cloud.google.com/gke-tpu-topology"] = self.topology
+        container = pod["containers"][0]
         container["image"] = self.image
         if job.get("confPath"):
             container["args"] = [f"conf={job['confPath']}"]
@@ -361,6 +374,8 @@ def make_job_client(conf: Optional[dict] = None, log_dir: Optional[str] = None):
             image=conf.get("image", "dxtpu:latest"),
             manifest_path=conf.get("manifest"),
             insecure=str(conf.get("insecure", "")).lower() == "true",
+            accelerator=conf.get("accelerator"),
+            topology=conf.get("topology"),
         )
     raise ValueError(f"unknown job client type {kind!r}")
 

@@ -171,6 +171,22 @@ class TestK8sJobClient:
         with pytest.raises(ValueError):
             make_job_client({"type": "slurm"})
 
+    def test_tpu_placement_overrides(self):
+        """provision.sh's TPU knobs reach the rendered per-flow Job."""
+        c = make_job_client({
+            "type": "k8s", "apiserver": "https://x:1",
+            "accelerator": "tpu-v6e-slice", "topology": "2x4",
+            "image": "reg/dxtpu:v9",
+        })
+        m = c.render_manifest({"name": "f1", "confPath": "c.conf"})
+        sel = m["spec"]["template"]["spec"]["nodeSelector"]
+        assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v6e-slice"
+        assert sel["cloud.google.com/gke-tpu-topology"] == "2x4"
+        assert (
+            m["spec"]["template"]["spec"]["containers"][0]["image"]
+            == "reg/dxtpu:v9"
+        )
+
 
 # -- object store ----------------------------------------------------------
 

@@ -327,5 +327,10 @@ def test_control_batches_skipped():
     # set isControl (bit 5) in attributes at offset 21 (8 base_offset +
     # 4 len + 4 epoch + 1 magic + 4 crc)
     marker[21:23] = struct.pack(">h", 0x20)
-    records = decode_record_batches(bytes(data_batch) + bytes(marker))
+    records, next_off = decode_record_batches(
+        bytes(data_batch) + bytes(marker)
+    )
     assert [(o, v) for o, _ts, v in records] == [(0, b'{"n":1}')]
+    # the position must advance PAST the skipped marker, or a marker at
+    # the log tail would be refetched in a hot loop forever
+    assert next_off == 2

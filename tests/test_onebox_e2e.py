@@ -158,3 +158,46 @@ def test_onebox_alert_semantics(flow_conf):
     # DoorOpenCount: only device 1 has an open DoorLock event
     assert [(r["deviceId"], r["Cnt"]) for r in datasets["DoorOpenCount"]] == [(1, 1)]
     assert metrics["Input_DataXProcessedInput_Events_Count"] == 3.0
+
+
+def test_provision_script_renders_valid_stack(tmp_path):
+    """deploy/provision.sh (the ARM/PS provisioning analog) in DRY_RUN:
+    every rendered manifest parses as YAML, carries the substituted
+    image/TPU settings, and covers the full service stack."""
+    import os
+    import subprocess
+
+    import yaml
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        ["bash", os.path.join(repo, "deploy", "provision.sh"), "testns"],
+        env={**os.environ, "DRY_RUN": "1", "IMAGE": "reg.example/dxtpu:v7",
+             "TPU_ACCELERATOR": "tpu-v6e-slice", "STORAGE_CLASS": "fast",
+             # multi-line value: seeding must keep it ONE secret
+             "DATAX_SECRET_MAINVAULT_TLSKEY":
+                 "-----BEGIN KEY-----\nabc=def\n-----END KEY-----"},
+        capture_output=True, text=True, check=True,
+    )
+    # strip the >> progress lines; the rest must be YAML documents
+    yaml_text = "\n".join(
+        ln for ln in out.stdout.splitlines() if not ln.startswith(">>")
+    )
+    docs = [d for d in yaml.safe_load_all(yaml_text) if d]
+    kinds = sorted(d["kind"] for d in docs)
+    assert kinds.count("Deployment") >= 3  # control plane, gateway/web x2, ingestor
+    assert "PersistentVolumeClaim" in kinds
+    assert "Service" in kinds
+    text = yaml_text
+    assert "reg.example/dxtpu:v7" in text
+    assert "dxtpu:latest" not in text  # image substituted everywhere
+    assert "storageClassName: fast" in text
+    assert "would seed secret dxtpu-secrets with 1 key(s)" in out.stdout
+    # the control plane submits per-flow TPU jobs itself; provisioning
+    # must hand it the SAME image + TPU placement
+    assert "jobclient=k8s" in text
+    assert "k8s.image=reg.example/dxtpu:v7" in text
+    assert "k8s.accelerator=tpu-v6e-slice" in text
+    # the per-flow TPU job template is NOT part of provisioning (the
+    # K8sJobClient renders it per job)
+    assert "kind: Job" not in text
