@@ -9,6 +9,7 @@ speaks the actual Kafka binary protocol directly over sockets:
 - Metadata v1        partition leaders per topic
 - ListOffsets v1     earliest/latest start positions
 - Fetch v4           record batches (message format v2, uncompressed)
+- Produce v3         egress (KafkaSink / EventHub-over-Kafka output)
 - SaslHandshake v0 + raw SASL PLAIN over TLS — the EventHub-compatible
   auth path (username ``$ConnectionString``, password the namespace
   connection string), exactly the setup the reference passes to its
@@ -44,6 +45,7 @@ from typing import Dict, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
+API_PRODUCE = 0
 API_FETCH = 1
 API_LIST_OFFSETS = 2
 API_METADATA = 3
@@ -310,25 +312,19 @@ class WireMessage:
         return None
 
 
-class WireKafkaConsumer:
-    """Manually-assigned consumer over the raw protocol.
-
-    Surface matches what ``KafkaSource`` drives: ``poll(timeout)`` ->
-    one message or None, ``seek(topic, partition, offset)``,
-    ``commit(offsets)`` (no-op — resume positions live in the
-    framework's OffsetCheckpointer), ``close()``.
-    """
+class KafkaWireClient:
+    """Shared transport + metadata layer: framing, SASL/TLS, broker
+    connections, topic metadata. The consumer and producer build on it."""
 
     def __init__(
         self,
         brokers: str,
         topics: List[str],
         client_id: str = "dxtpu-wire",
-        security: Optional[str] = None,  # None | "sasl_ssl" | "ssl"
+        security: Optional[str] = None,  # None | ssl | sasl_ssl | sasl_plaintext
         username: Optional[str] = None,
         password: Optional[str] = None,
         timeout_s: float = 10.0,
-        fetch_max_bytes: int = 4 * 1024 * 1024,
     ):
         self.bootstrap = []
         for entry in brokers.split(","):
@@ -349,13 +345,10 @@ class WireKafkaConsumer:
         self.username = username
         self.password = password
         self.timeout_s = timeout_s
-        self.fetch_max_bytes = fetch_max_bytes
         self._corr = 0
         self._socks: Dict[Tuple[str, int], socket.socket] = {}
         # (topic, partition) -> (leader host, port)
         self._leaders: Dict[Tuple[str, int], Tuple[str, int]] = {}
-        self._positions: Dict[Tuple[str, int], int] = {}
-        self._buffer: List[WireMessage] = []
         self._lock = threading.Lock()
         self._meta_loaded = False
 
@@ -492,6 +485,31 @@ class WireKafkaConsumer:
                 return offset
         raise IOError("empty ListOffsets response")
 
+    def close(self) -> None:
+        for s in self._socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._socks.clear()
+
+
+class WireKafkaConsumer(KafkaWireClient):
+    """Manually-assigned consumer over the raw protocol.
+
+    Surface matches what ``KafkaSource`` drives: ``poll(timeout)`` ->
+    one message or None, ``seek(topic, partition, offset)``,
+    ``commit(offsets)`` (no-op — resume positions live in the
+    framework's OffsetCheckpointer), ``close()``.
+    """
+
+    def __init__(self, *args, fetch_max_bytes: int = 4 * 1024 * 1024,
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        self.fetch_max_bytes = fetch_max_bytes
+        self._positions: Dict[Tuple[str, int], int] = {}
+        self._buffer: List[WireMessage] = []
+
     # -- consumer surface ------------------------------------------------
     def seek(self, topic: str, partition: int, offset: int) -> None:
         with self._lock:
@@ -580,10 +598,69 @@ class WireKafkaConsumer:
                     if new_pos > self._positions[pos_key]:
                         self._positions[pos_key] = new_pos
 
-    def close(self) -> None:
-        for s in self._socks.values():
-            try:
-                s.close()
-            except OSError:
-                pass
-        self._socks.clear()
+
+class WireKafkaProducer(KafkaWireClient):
+    """Minimal producer over Produce v3 (acks=1, uncompressed v2 record
+    batches) — the egress half of the wire client. This is what lets a
+    flow SINK to Kafka (and EventHub via its Kafka endpoint — the
+    reference's EventHubStreamPoster role) on hosts with no client
+    library; batches round-robin across the topic's partitions."""
+
+    def __init__(self, brokers: str, topic: str, acks: int = 1, **kwargs):
+        super().__init__(brokers, [topic], **kwargs)
+        self.topic = topic
+        self.acks = acks
+        self._rr = 0
+
+    def send(self, values: List[bytes]) -> None:
+        """Produce one record batch; raises on broker error so the
+        caller's batch retry owns delivery (at-least-once)."""
+        if not values:
+            return
+        if not self._meta_loaded:
+            self._refresh_metadata()
+        parts = sorted(
+            p for (t, p) in self._leaders if t == self.topic
+        )
+        if not parts:
+            raise IOError(f"kafka topic {self.topic!r} has no partitions")
+        partition = parts[self._rr % len(parts)]
+        self._rr += 1
+        records = encode_record_batch(
+            0, values, timestamp_ms=int(time.time() * 1000)
+        )
+        body = (
+            enc_str(None)  # transactional_id
+            + enc_i16(self.acks)
+            + enc_i32(int(self.timeout_s * 1000))
+            + enc_array([
+                enc_str(self.topic) + enc_array([
+                    enc_i32(partition) + enc_bytes(records)
+                ])
+            ])
+        )
+        s = self._connect(*self._leaders[(self.topic, partition)])
+        try:
+            r = self._request(s, API_PRODUCE, 3, body)
+        except (OSError, ConnectionError):
+            # stale leader/socket: refresh and propagate for batch retry
+            self.close()
+            self._meta_loaded = False
+            raise
+        for _ in range(r.i32()):
+            r.string()  # topic
+            for _ in range(r.i32()):
+                r.i32()  # partition
+                err = r.i16()
+                r.i64()  # base offset
+                r.i64()  # log append time
+                if err:
+                    # broker-level error (e.g. 6 NOT_LEADER_FOR_PARTITION
+                    # after a leadership move): drop cached metadata so
+                    # the caller's batch retry re-resolves leaders
+                    # instead of re-hitting the stale one forever
+                    self.close()
+                    self._meta_loaded = False
+                    raise IOError(f"kafka produce error {err}")
+        # NOTE: Produce responses carry throttle_time_ms LAST (v1+)
+        r.i32()

@@ -347,6 +347,45 @@ class StreamSink(Sink):
         return len(rows)
 
 
+class KafkaSink(Sink):
+    """Rows out to a Kafka topic — or EventHub through its
+    Kafka-compatible endpoint, the reference EventHubStreamPoster's
+    transport (sink/EventHubStreamPoster.scala:15-81) in its
+    EventHub-over-Kafka form. Uses the dependency-free wire producer
+    (runtime/kafka_wire.py), so it works on hosts without a Kafka
+    client library; produce errors raise so the batch retries
+    (at-least-once)."""
+
+    kind = "kafka"
+
+    def __init__(
+        self,
+        brokers: str,
+        topic: str,
+        security=None,
+        username=None,
+        password=None,
+    ):
+        from .kafka_wire import WireKafkaProducer
+
+        self._producer = WireKafkaProducer(
+            brokers, topic, security=security,
+            username=username, password=password,
+        )
+        self._lock = threading.Lock()
+
+    def write(self, dataset, rows, batch_time_ms) -> int:
+        if not rows:
+            return 0
+        payload = [json.dumps(r, default=str).encode() for r in rows]
+        with self._lock:
+            self._producer.send(payload)
+        return len(rows)
+
+    def close(self) -> None:
+        self._producer.close()
+
+
 class MetricSink(Sink):
     """Routes a dataset's rows into the metrics pipeline.
 
@@ -442,6 +481,25 @@ def build_output_operators(
                     sconf.get_or_else("connectionstring", "/tmp/dxtpu-docs"),
                     sconf.get_or_else("database", "db"),
                     sconf.get_or_else("collection", out_name),
+                ))
+            elif sink_kind in ("kafka", "eventhubkafka", "eventhub-kafka"):
+                # conf: datax.job.output.<n>.kafka.{bootstrapservers,topic,
+                # security,username,password}; the eventhub flavor (same
+                # spelling as inputtype=eventhub-kafka) defaults the SASL
+                # triplet to the EventHub Kafka-endpoint convention
+                username = sconf.get("username")
+                password = sconf.get("password")
+                security = sconf.get("security")
+                if sink_kind != "kafka":
+                    security = security or "sasl_ssl"
+                    username = username or "$ConnectionString"
+                    password = password or sconf.get("connectionstring")
+                sinks.append(KafkaSink(
+                    sconf.get_or_else("bootstrapservers", "localhost:9092"),
+                    sconf.get_or_else("topic", out_name),
+                    security=security,
+                    username=username,
+                    password=password,
                 ))
             elif sink_kind in ("eventhub", "stream"):
                 # connection "host:port" (EventHub conn-string role); any

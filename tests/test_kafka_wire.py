@@ -16,9 +16,11 @@ from data_accelerator_tpu.runtime.kafka_wire import (
     API_FETCH,
     API_LIST_OFFSETS,
     API_METADATA,
+    API_PRODUCE,
     API_SASL_HANDSHAKE,
     Reader,
     WireKafkaConsumer,
+    WireKafkaProducer,
     enc_array,
     enc_i8,
     enc_i16,
@@ -110,6 +112,8 @@ class FakeBroker:
                     body = self._list_offsets(r)
                 elif api_key == API_FETCH:
                     body = self._fetch(r)
+                elif api_key == API_PRODUCE:
+                    body = self._produce(r)
                 else:
                     conn.close()
                     return
@@ -151,6 +155,32 @@ class FakeBroker:
             out_topics.append(enc_str(t) + enc_array(parts))
         # v1: NO throttle_time_ms (that field arrived in v2)
         return enc_array(out_topics)
+
+    def _produce(self, r):
+        from data_accelerator_tpu.runtime.kafka_wire import (
+            decode_record_batches,
+        )
+
+        r.string()  # transactional id (nullable)
+        r.i16()  # acks
+        r.i32()  # timeout
+        out_topics = []
+        for _ in range(r.i32()):
+            t = r.string()
+            parts = []
+            for _ in range(r.i32()):
+                p = r.i32()
+                records = r.bytes_() or b""
+                log = self.topics.setdefault(t, {}).setdefault(p, [])
+                base = len(log)
+                recs, _next = decode_record_batches(records)
+                log.extend(v for _o, _ts, v in recs)
+                parts.append(
+                    enc_i32(p) + enc_i16(0) + enc_i64(base) + enc_i64(-1)
+                )
+            out_topics.append(enc_str(t) + enc_array(parts))
+        # Produce v1+: throttle_time_ms LAST
+        return enc_array(out_topics) + enc_i32(0)
 
     def _fetch(self, r):
         r.i32()  # replica
@@ -334,3 +364,66 @@ def test_control_batches_skipped():
     # the position must advance PAST the skipped marker, or a marker at
     # the log tail would be refetched in a hot loop forever
     assert next_off == 2
+
+
+class TestWireProducer:
+    def test_produce_then_consume_roundtrip(self):
+        """Rows produced over the wire land in the broker log and come
+        back through the wire consumer — the full egress->ingress loop
+        a chained flow pair rides."""
+        b = FakeBroker({"out": {0: []}})
+        try:
+            prod = WireKafkaProducer(f"127.0.0.1:{b.port}", "out")
+            prod.send([b'{"n":1}', b'{"n":2}'])
+            prod.send([b'{"n":3}'])
+            prod.close()
+            c = WireKafkaConsumer(f"127.0.0.1:{b.port}", ["out"])
+            got = []
+            for _ in range(5):
+                m = c.poll(0.2)
+                if m is None:
+                    break
+                got.append((m.offset(), json.loads(m.value())["n"]))
+            c.close()
+            assert got == [(0, 1), (1, 2), (2, 3)]
+        finally:
+            b.close()
+
+    def test_kafka_sink_writes_rows(self):
+        from data_accelerator_tpu.runtime.sinks import KafkaSink
+
+        b = FakeBroker({"alerts": {0: []}})
+        try:
+            sink = KafkaSink(f"127.0.0.1:{b.port}", "alerts")
+            n = sink.write("Alerts", [{"deviceId": 7}, {"deviceId": 9}], 0)
+            assert n == 2
+            sink.close()
+            assert [json.loads(v)["deviceId"]
+                    for v in b.topics["alerts"][0]] == [7, 9]
+        finally:
+            b.close()
+
+
+def test_eventhub_kafka_sink_conf_spelling():
+    """The documented hyphenated namespace builds the SASL-defaulted
+    sink (a silent drop here would discard output rows)."""
+    from data_accelerator_tpu.core.config import SettingDictionary
+    from data_accelerator_tpu.obs.metrics import MetricLogger
+    from data_accelerator_tpu.runtime.sinks import (
+        KafkaSink,
+        build_output_operators,
+    )
+
+    d = SettingDictionary({
+        "datax.job.output.Alerts.eventhub-kafka.bootstrapservers":
+            "ns.servicebus.windows.net:9093",
+        "datax.job.output.Alerts.eventhub-kafka.topic": "hub1",
+        "datax.job.output.Alerts.eventhub-kafka.connectionstring":
+            "Endpoint=sb://ns/...",
+    })
+    ops = build_output_operators(d, MetricLogger([]), {"Alerts": ["Alerts"]})
+    [sink] = ops["Alerts"].sinks
+    assert isinstance(sink, KafkaSink)
+    assert sink._producer.security == "sasl_ssl"
+    assert sink._producer.username == "$ConnectionString"
+    assert sink._producer.password == "Endpoint=sb://ns/..."
