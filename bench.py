@@ -102,27 +102,47 @@ def bench_decoder(proc, payload, n_rows, iters=8):
 
 
 def pipelined_ingest_loop(proc, payloads, iters, base_ms):
-    """The production throughput shape: decode N+1 while N
-    computes/transports. Returns events/s and per-batch t0->collected ms
+    """The production throughput shape (StreamingHost.run_pipelined):
+    a decode-ahead worker thread parses batch N+1's JSON (the C++
+    decoder releases the GIL) while the main thread dispatches batch N
+    and collects N-1 — so host decode overlaps device compute AND
+    result transport. Returns events/s and per-batch t0->collected ms
     (t0 BEFORE the decode, so ingest-inclusive)."""
-    lat_collect = []
-    pending = None  # (handle, t0)
-    t_start = time.perf_counter()
-    for i in range(iters):
+    from concurrent.futures import ThreadPoolExecutor
+
+    def decode(i):
         t0 = time.perf_counter()
         raw = proc.encode_json_bytes(
-            payloads[i % len(payloads)], base_ms + i * 1000
+            payloads[i % len(payloads)], base_ms + i * 1000,
+            to_device=False,
         )
-        handle = proc.dispatch_batch(raw, batch_time_ms=base_ms + i * 1000)
-        if pending is not None:
-            ph, pt0 = pending
-            ph.collect()
-            lat_collect.append((time.perf_counter() - pt0) * 1000.0)
-        pending = (handle, t0)
-    ph, pt0 = pending
-    ph.collect()
-    lat_collect.append((time.perf_counter() - pt0) * 1000.0)
-    total_s = time.perf_counter() - t_start
+        return raw, t0
+
+    lat_collect = []
+    pending = None  # (handle, t0)
+    pool = ThreadPoolExecutor(1)
+    try:
+        t_start = time.perf_counter()
+        fut = pool.submit(decode, 0)
+        for i in range(iters):
+            raw, t0 = fut.result()
+            fut = None
+            if i + 1 < iters:
+                fut = pool.submit(decode, i + 1)
+            handle = proc.dispatch_batch(
+                raw, batch_time_ms=base_ms + i * 1000
+            )
+            if pending is not None:
+                ph, pt0 = pending
+                ph.collect()
+                lat_collect.append((time.perf_counter() - pt0) * 1000.0)
+            pending = (handle, t0)
+        ph, pt0 = pending
+        ph.collect()
+        lat_collect.append((time.perf_counter() - pt0) * 1000.0)
+        total_s = time.perf_counter() - t_start
+    finally:
+        pool.shutdown(wait=False, cancel_futures=True)
     events = proc.batch_capacity * iters
     return events / total_s, lat_collect
 

@@ -840,6 +840,61 @@ class ExprCompiler:
             return CompiledExpr(
                 "tssec", lambda env, ts=ts: ts.fn(env) // 1000, deps=ts.deps
             )
+        if name in ("STRINGTOTIMESTAMP", "TO_TIMESTAMP"):
+            # reference: BuiltInFunctionsHandler.scala:15-17 registers
+            # stringToTimestamp (ConcurrentDateFormat) as the one
+            # built-in UDF. Here: per-distinct-string parse on the host
+            # via two aux tables (epoch seconds + millis fraction),
+            # composed into batch-relative ms on device. Unparseable or
+            # NULL strings yield relative 0 (the missing-timestamp
+            # encode convention) rather than SQL NULL — int32 columns
+            # carry no null slot.
+            if len(e.args) != 1:
+                raise EngineException(
+                    f"{name} takes exactly one string argument (custom "
+                    "format patterns are not supported; timestamps parse "
+                    "as ISO-8601 or epoch seconds/millis)"
+                )
+            v = self._string_arg(e.args[0], name)
+            from ..core.batch import parse_timestamp_ms
+
+            int_min = -(2 ** 31)
+
+            def sec_of(s: str):
+                # aux tables are int32: any epoch-second value outside
+                # the range (e.g. an 11-digit id parsed as a huge epoch,
+                # or post-2038 dates) counts as unparseable — the table
+                # write itself would otherwise OverflowError per batch
+                ms = parse_timestamp_ms(s)
+                if ms is None:
+                    return int_min
+                sec = int(ms // 1000)
+                return sec if int_min < sec < 2 ** 31 else int_min
+
+            def msfrac_of(s: str):
+                ms = parse_timestamp_ms(s)
+                return 0 if ms is None else int(ms % 1000)
+
+            self.aux.register("ts.sec", "scalar", sec_of)
+            self.aux.register("ts.msfrac", "scalar", msfrac_of)
+
+            def run(env, arg=v, int_min=int_min):
+                tsec = env.scopes["__aux"]["ts.sec"]
+                tms = env.scopes["__aux"]["ts.msfrac"]
+                ids = arg.fn(env)
+                idx = jnp.clip(ids, 0, tsec.shape[0] - 1)
+                sec = tsec[idx]
+                bad = (ids <= 0) | (sec == int_min)
+                # saturate the batch-relative delta at ~±23 days before
+                # the ms scaling (the ingest paths clip the same way) —
+                # int32 would otherwise wrap and pass comparisons it
+                # should fail
+                delta_s = jnp.clip(sec - env.base_s, -2_000_000, 2_000_000)
+                rel = delta_s * 1000 + tms[idx]
+                return jnp.where(bad, 0, rel).astype(jnp.int32)
+
+            return CompiledExpr("timestamp", run, deps=v.deps)
+
         if name == "DATE_TRUNC":
             unit_lit = e.args[0]
             if not isinstance(unit_lit, Literal):

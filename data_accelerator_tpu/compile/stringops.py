@@ -281,7 +281,11 @@ class AuxTableBuilder:
             self._build_rank(capacity)
             out[RANK_KEY] = jnp.asarray(self._np[RANK_KEY])
             out[UNRANK_KEY] = jnp.asarray(self._np[UNRANK_KEY])
-        self._built_len = len(self.dictionary)
+        # record what was actually COMPUTED (_filled), not the current
+        # dictionary length: a decode-ahead ingest thread may append
+        # entries between the extend above and here, and marking those
+        # as built would leave their table slots 0/NULL forever
+        self._built_len = self._filled
         self._device = out
         return out
 

@@ -169,8 +169,15 @@ class StringDictionary:
     NULL_ID = 0
 
     def __init__(self, max_size: Optional[int] = None, strict: bool = False):
+        import threading
+
         self._to_id: Dict[str, int] = {}
         self._to_str: List[Optional[str]] = [None]  # id 0 -> null
+        # encode is check-then-append: the decode-ahead ingest worker
+        # and the main thread's aux-table build both insert, so the
+        # write path must be serialized (reads stay lock-free — CPython
+        # list/dict reads see a consistent prefix)
+        self._write_lock = threading.Lock()
         # optional capacity bound (conf process.stringdictionary.maxsize):
         # a hostile/high-cardinality stream would otherwise grow the
         # dictionary — and every device lookup table derived from it —
@@ -190,7 +197,12 @@ class StringDictionary:
         if s is None:
             return self.NULL_ID
         i = self._to_id.get(s)
-        if i is None:
+        if i is not None:
+            return i
+        with self._write_lock:
+            i = self._to_id.get(s)  # racer may have inserted it
+            if i is not None:
+                return i
             if self.max_size is not None and len(self._to_str) >= self.max_size:
                 if self.strict:
                     raise DictionaryFullError(
@@ -203,7 +215,7 @@ class StringDictionary:
             i = len(self._to_str)
             self._to_str.append(s)
             self._to_id[s] = i
-        return i
+            return i
 
     def entries(self) -> List[str]:
         """Every non-null entry in id order (id 1 first) — the snapshot
@@ -222,13 +234,14 @@ class StringDictionary:
         state (device rings reference their ids), so an operator who
         lowered ``maxsize`` below the saved size must still get an exact
         restore — the bound applies to NEW strings only."""
-        current = self._to_str[1:]
-        if current != saved[: len(current)]:
-            return False
-        for s in saved[len(current):]:
-            self._to_id[s] = len(self._to_str)
-            self._to_str.append(s)
-        return True
+        with self._write_lock:
+            current = self._to_str[1:]
+            if current != saved[: len(current)]:
+                return False
+            for s in saved[len(current):]:
+                self._to_id[s] = len(self._to_str)
+                self._to_str.append(s)
+            return True
 
     def lookup(self, s: Optional[str]) -> int:
         """Encode without inserting; unseen strings get -1 (matches nothing)."""

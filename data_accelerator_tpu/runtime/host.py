@@ -157,10 +157,14 @@ class StreamingHost:
                     # timestamps shift across a second boundary
                     batch_time_ms = now_ms
             elif hasattr(src, "poll_raw"):
-                # native ingest: raw JSON bytes -> C++ decoder -> device
+                # native ingest: raw JSON bytes -> C++ decoder; the
+                # packed matrix stays numpy (to_device=False) so the
+                # decode-ahead worker never touches jax off-thread —
+                # the jitted step's call transfers it
                 blob, _n, c = src.poll_raw(max_events)
                 raw[name] = self.processor.encode_json_bytes(
-                    blob, (batch_time_ms // 1000) * 1000, source=name
+                    blob, (batch_time_ms // 1000) * 1000, source=name,
+                    to_device=False,
                 )
             else:
                 rows, c = src.poll(max_events)
@@ -288,17 +292,37 @@ class StreamingHost:
             self._stop_profiler()
 
     def run_pipelined(self, max_batches: Optional[int] = None) -> None:
-        """Unpaced loop with one batch in flight: while the device runs
-        batch N, the host encodes and dispatches N+1, then collects N
-        and runs its sinks — throughput mode, where the wall-clock per
-        batch is max(device, host) instead of their sum (the reference's
-        receiver-thread overlap, P6, done on the device stream).
+        """Unpaced loop with one batch in flight: a decode-ahead worker
+        thread polls + decodes batch N+1 (the C++ JSON decoder releases
+        the GIL, so this genuinely overlaps) while the main thread
+        dispatches batch N to the device and collects batch N-1's
+        results for its sinks — throughput mode, where the wall-clock
+        per batch approaches max(decode, device+transport) instead of
+        their sum (the reference's receiver-thread overlap, P6).
 
-        At-least-once holds across the depth-2 window: each batch joins
-        the source's un-acked FIFO at poll time and is acked (in order)
-        only after its own sinks succeed; a failure requeues every
-        un-acked batch before rethrowing."""
+        At-least-once holds across the window: each batch joins the
+        source's un-acked FIFO at poll time (the FIFO is lock-guarded)
+        and is acked (in order) only after its own sinks succeed; a
+        failure anywhere requeues every un-acked batch before
+        rethrowing."""
+        from concurrent.futures import ThreadPoolExecutor
+
         pending = None  # (PendingBatch, consumed offsets, batch_time_ms, t0)
+        pool = ThreadPoolExecutor(1)
+        fut = None
+
+        def drain(f):
+            """Wait out an in-flight poll so its delivery lands in the
+            un-acked FIFO BEFORE any requeue — abandoning it would
+            strand a polled batch in _inflight, where a later ack would
+            release (and for Kafka, commit) it unprocessed."""
+            if f is None:
+                return
+            try:
+                f.result(timeout=60)
+            except Exception:  # noqa: BLE001 — failed poll requeued below
+                pass
+
         try:
             while not self._stop:
                 inflight = 1 if pending is not None else 0
@@ -308,16 +332,44 @@ class StreamingHost:
                 ):
                     break
                 iter_t0 = time.time()
-                started = self._start_batch()
+                self._profiler_tick()
+                if fut is None:
+                    fut = pool.submit(self._poll_and_encode)
+                raw, consumed, batch_time_ms, t0 = fut.result()
+                fut = None
+                self.telemetry.batch_begin(batch_time_ms)
+                handle = self.processor.dispatch_batch(raw, batch_time_ms)
+                # decode-ahead: the NEXT batch's poll starts now,
+                # overlapping the previous batch's collect + sinks —
+                # but only if a next iteration will actually run
+                # (batches started so far incl. this one = processed +
+                # unfinished pending + this)
+                started = self.batches_processed + inflight + 1
+                if not self._stop and (
+                    max_batches is None or started < max_batches
+                ):
+                    fut = pool.submit(self._poll_and_encode)
                 if pending is not None:
                     self._finish(*pending)
                 # backpressure on iteration time, not Latency-Batch: a
                 # pipelined batch's latency spans ~2 iterations by design
                 self._update_backpressure((time.time() - iter_t0) * 1000.0)
-                pending = started
+                pending = (handle, consumed, batch_time_ms, t0)
             if pending is not None and not self._stop:
                 self._finish(*pending)
+        except Exception:
+            # settle the in-flight poll FIRST, then requeue everything
+            # un-acked (covers poll/dispatch failures; _finish requeues
+            # its own failures before rethrowing, and requeue_unacked
+            # is idempotent)
+            drain(fut)
+            fut = None
+            for s in self.sources.values():
+                s.requeue_unacked()
+            raise
         finally:
+            drain(fut)
+            pool.shutdown(wait=False, cancel_futures=True)
             self._stop_profiler()
 
     def _stop_profiler(self) -> None:

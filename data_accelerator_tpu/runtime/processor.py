@@ -184,9 +184,16 @@ class PackedRaw:
         return TableData(cols, self.data[len(self.layout)] != 0)
 
 
-def pack_raw(np_cols: Dict[str, np.ndarray], valid: np.ndarray) -> PackedRaw:
+def pack_raw(
+    np_cols: Dict[str, np.ndarray], valid: np.ndarray,
+    to_device: bool = True,
+) -> PackedRaw:
     """Stack host columns into the single-transfer matrix (cheap host
-    memcpy; the win is one device transfer instead of n_cols+1)."""
+    memcpy; the win is one device transfer instead of n_cols+1).
+
+    ``to_device=False`` keeps the matrix as numpy — the jitted step's
+    call transfers it implicitly — so a decode-ahead worker thread can
+    build batches without touching jax from off the main thread."""
     rows: List[np.ndarray] = []
     layout: List[Tuple[str, str]] = []
     for c, a in np_cols.items():
@@ -206,7 +213,10 @@ def pack_raw(np_cols: Dict[str, np.ndarray], valid: np.ndarray) -> PackedRaw:
         rows.append(a)
         layout.append((c, kind))
     rows.append(valid.astype(np.int32))
-    return PackedRaw(jnp.asarray(np.stack(rows)), tuple(layout))
+    stacked = np.stack(rows)
+    return PackedRaw(
+        jnp.asarray(stacked) if to_device else stacked, tuple(layout)
+    )
 
 
 @dataclass
@@ -898,6 +908,7 @@ class FlowProcessor:
         base_ms: int,
         source: Optional[str] = None,
         packed: Optional[bool] = None,
+        to_device: bool = True,
     ) -> Union[TableData, "PackedRaw"]:
         """Native ingest hot path: newline-delimited JSON bytes decoded by
         the C++ decoder (native/decoder.cpp) straight into columnar
@@ -972,7 +983,7 @@ class FlowProcessor:
                 else:
                     np_cols[extra] = np.zeros(cap, np.int32)
         if packed:
-            return pack_raw(np_cols, np.asarray(valid))
+            return pack_raw(np_cols, np.asarray(valid), to_device=to_device)
         return TableData(
             {c: jnp.asarray(a) for c, a in np_cols.items()},
             jnp.asarray(valid),

@@ -153,3 +153,37 @@ def test_socket_source_depth2_inflight_ack_and_requeue():
         conn.close()
     finally:
         src.close()
+
+
+def test_run_pipelined_polls_exactly_max_batches(tmp_path):
+    """The decode-ahead prefetch must not poll a batch it will never
+    dispatch: an orphaned poll sits in the un-acked FIFO, where a later
+    in-order ack would release (for Kafka: commit) it unprocessed."""
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    t = tmp_path / "t.transform"
+    t.write_text(
+        "--DataXQuery--\n"
+        "Out = SELECT k, v FROM DataXProcessedInput\n"
+    )
+    conf = SettingDictionary({
+        "datax.job.name": "PollCount",
+        "datax.job.input.default.inputtype": "local",
+        "datax.job.input.default.blobschemafile": SCHEMA,
+        "datax.job.process.batchcapacity": "16",
+        "datax.job.process.transform": str(t),
+        "datax.job.output.Out.console.maxrows": "0",
+    })
+    host = StreamingHost(conf)
+    src = host.source
+    polls = {"n": 0}
+    orig = src.poll_columns
+
+    def counting_poll(*a, **k):
+        polls["n"] += 1
+        return orig(*a, **k)
+
+    src.poll_columns = counting_poll
+    host.run_pipelined(max_batches=3)
+    host.stop()
+    assert host.batches_processed == 3
+    assert polls["n"] == 3  # not 4: no orphaned prefetch

@@ -680,3 +680,37 @@ def test_order_by_mixed_scope_expression_binds_alias_first():
 def test_regexp_replace_unicode_digit_after_dollar_rejected():
     with pytest.raises(EngineException, match="illegal group reference"):
         one_col("REGEXP_REPLACE(s, '(o)', '$²')")
+
+
+def test_string_to_timestamp_builtin():
+    """stringToTimestamp/TO_TIMESTAMP (reference
+    BuiltInFunctionsHandler.scala's one builtin): per-distinct-string
+    host parse -> device-relative ms, windowable/comparable like any
+    timestamp; unparseable -> relative 0."""
+    base_s = 1_700_000_000
+    vals = [
+        "2023-11-14T22:13:25Z",       # base + 5s
+        "1700000030",                 # epoch seconds: base + 30s
+        "garbage",
+        None,
+    ]
+    rows, view, _ = run_select(
+        "SELECT stringToTimestamp(s) AS ts, n FROM T",
+        {"s": vals, "n": list(range(4))},
+        {"s": "string", "n": "long"},
+        base_s=base_s,
+    )
+    assert view.schema.types["ts"] == "timestamp"
+    got = {r["n"]: r["ts"] for r in rows}
+    assert got[0] == 5000
+    assert got[1] == 30000
+    assert got[2] == 0 and got[3] == 0
+
+    # usable inside comparisons (the normalization-snippet use)
+    rows, _, _ = run_select(
+        "SELECT n FROM T WHERE TO_TIMESTAMP(s) > 7000",
+        {"s": vals, "n": list(range(4))},
+        {"s": "string", "n": "long"},
+        base_s=base_s,
+    )
+    assert [r["n"] for r in rows] == [1]
