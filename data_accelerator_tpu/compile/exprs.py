@@ -556,7 +556,10 @@ class ExprCompiler:
 
         import operator as _op
 
-        fns = {"+": _op.add, "-": _op.sub, "*": _op.mul, "%": _op.mod}
+        # '%' is TRUNCATED modulo (sign follows the dividend) per
+        # Spark/SQL semantics — jnp.mod/Python % are floored and flip
+        # the sign for negative dividends
+        fns = {"+": _op.add, "-": _op.sub, "*": _op.mul, "%": jnp.fmod}
 
         def run(env, l=l, r=r, op=op, out_t=out_t):
             a, b = _to_dtype(l.fn(env), out_t), _to_dtype(r.fn(env), out_t)
@@ -924,6 +927,60 @@ class ExprCompiler:
 
             return CompiledExpr("long", run, deps=ts.deps)
 
+        if name in ("GREATEST", "LEAST"):
+            if len(e.args) < 2:
+                raise EngineException(f"{name} needs at least two arguments")
+            vals = [self._as_device(a) for a in e.args]
+            for v in vals:
+                if v.type not in ("long", "double", "timestamp", "tssec"):
+                    raise EngineException(
+                        f"{name} expects numeric arguments, got {v.type}"
+                    )
+            out_t = "double" if any(v.type == "double" for v in vals) else "long"
+            jf = jnp.maximum if name == "GREATEST" else jnp.minimum
+            dt = _DTYPES[out_t]
+
+            def run(env, vals=vals, jf=jf, dt=dt):
+                acc = vals[0].fn(env).astype(dt)
+                for v in vals[1:]:
+                    acc = jf(acc, v.fn(env).astype(dt))
+                return acc
+
+            return CompiledExpr(
+                out_t, run,
+                deps=tuple(d for v in vals for d in v.deps),
+            )
+        if name in ("POW", "POWER"):
+            base_v = self._as_device(e.args[0])
+            exp_v = self._as_device(e.args[1])
+            _promote(base_v.type, exp_v.type)  # rejects strings/booleans mix
+            if "string" in (base_v.type, exp_v.type):
+                raise EngineException("POW expects numeric arguments")
+            return CompiledExpr(
+                "double",
+                lambda env, b=base_v, x=exp_v: jnp.power(
+                    b.fn(env).astype(jnp.float32),
+                    x.fn(env).astype(jnp.float32),
+                ),
+                deps=base_v.deps + exp_v.deps,
+            )
+        if name == "MOD":
+            # delegate to the '%' operator path: same promotion, same
+            # string guard, same truncated-modulo semantics
+            return self._arith(
+                "%", self._as_device(e.args[0]), self._as_device(e.args[1])
+            )
+        if name == "SIGN":
+            v = self._as_device(e.args[0])
+            if v.type not in ("long", "double"):
+                raise EngineException(
+                    f"SIGN expects a numeric argument, got {v.type}"
+                )
+            return CompiledExpr(
+                "double",
+                lambda env, v=v: jnp.sign(v.fn(env)).astype(jnp.float32),
+                deps=v.deps,
+            )
         if name in ("ABS", "FLOOR", "CEIL", "ROUND", "SQRT", "EXP", "LOG"):
             v = self._as_device(e.args[0])
             jf = {

@@ -411,7 +411,8 @@ class _Parser:
         negated = False
         if (
             self.peek().kind == "kw" and self.peek().value == "NOT"
-            and self.peek(1).value.upper() in ("IN", "LIKE", "RLIKE", "REGEXP")
+            and self.peek(1).value.upper()
+            in ("IN", "LIKE", "RLIKE", "REGEXP", "BETWEEN")
         ):
             self.next()
             negated = True
@@ -434,7 +435,20 @@ class _Parser:
             lo = self.parse_additive()
             self.expect_kw("AND")
             hi = self.parse_additive()
+            if negated:
+                # NOT BETWEEN desugars to strict comparisons, NOT to
+                # NOT(range): comparisons over NULL are false on both
+                # sides, so NULL rows stay excluded (Spark semantics),
+                # where a bare NOT would flip them to included
+                return BinOp(
+                    "OR", BinOp("<", left, lo), BinOp(">", left, hi)
+                )
             return BinOp("AND", BinOp(">=", left, lo), BinOp("<=", left, hi))
+        if negated:
+            raise SqlParseError(
+                "NOT must be followed by IN/LIKE/RLIKE/BETWEEN near "
+                f"{self.peek().value!r}"
+            )
         return left
 
     def parse_additive(self) -> Expr:

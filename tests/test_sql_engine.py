@@ -389,3 +389,26 @@ def test_output_counts_follow_declaration_order(tmp_path):
     assert [r["v"] for r in datasets["Apple"]] == [1]
     assert metrics["Output_Zebra_Events_Count"] == 3.0
     assert metrics["Output_Apple_Events_Count"] == 1.0
+
+
+def test_numeric_scalar_functions():
+    """GREATEST/LEAST/POW/MOD/SIGN (Spark-dialect scalars)."""
+    from test_computed_strings import run_sql
+
+    T = {"a": [1.5, 2.5, -3.0], "n": [7, 8, 9]}
+    TT = {"a": "double", "n": "long"}
+    rows, _, _ = run_sql(
+        "SELECT GREATEST(a, 2.0) AS g, LEAST(n, 8) AS l, "
+        "POW(n, 2) AS p, MOD(n, 2) AS m, SIGN(a) AS s FROM T",
+        {"T": (T, TT)},
+    )
+    assert [r["g"] for r in rows] == [2.0, 2.5, 2.0]
+    assert [r["l"] for r in rows] == [7, 8, 8]
+    assert [r["p"] for r in rows] == [49.0, 64.0, 81.0]
+    assert [r["m"] for r in rows] == [1, 0, 1]
+    assert [r["s"] for r in rows] == [1.0, 1.0, -1.0]
+    # GREATEST across int+double promotes
+    rows, _, _ = run_sql(
+        "SELECT GREATEST(n, a, 8.1) AS g FROM T", {"T": (T, TT)}
+    )
+    assert [round(r["g"], 4) for r in rows] == [8.1, 8.1, 9.0]

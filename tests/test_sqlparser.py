@@ -138,3 +138,13 @@ def test_distinct_date_trunc():
     )
     assert s.distinct
     assert s.items[0].expr.name == "DATE_TRUNC"
+
+
+def test_not_between():
+    from data_accelerator_tpu.compile.sqlparser import BinOp, parse_select
+
+    # NOT BETWEEN desugars to strict comparisons (not NOT(range)) so
+    # NULL rows stay excluded, matching Spark
+    sel = parse_select("SELECT n FROM T WHERE a NOT BETWEEN 2 AND 3")
+    assert isinstance(sel.where, BinOp) and sel.where.op == "OR"
+    assert sel.where.left.op == "<" and sel.where.right.op == ">"
