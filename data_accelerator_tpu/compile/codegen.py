@@ -246,9 +246,19 @@ class CodegenEngine:
         self.output_templates = output_templates or OUTPUT_TEMPLATES
 
     def generate_code(
-        self, code: str, rules_json: str, product_id: str
+        self, code: str, rules_json: str, product_id: str,
+        windowable_tables=None,
     ) -> RulesCode:
+        """``windowable_tables``: table names TIMEWINDOW may target
+        (None = unrestricted, for direct compiler users); generation
+        passes the main projection + declared source targets so a typo
+        fails HERE with a clear message instead of silently windowing
+        the wrong table at runtime."""
         self._code = code
+        self._windowable = (
+            {t.lower() for t in windowable_tables}
+            if windowable_tables is not None else None
+        )
         self._statement_number = 0
         self._rule_counter = 1
         self._all_rules = [Rule.from_json(o) for o in json.loads(rules_json or "[]")]
@@ -514,30 +524,55 @@ class CodegenEngine:
             self._code = self._code.replace(m.group(0), new_query)
 
     def _process_time_windows(self) -> Dict[str, str]:
-        """``FROM DataXProcessedInput TIMEWINDOW('5 minutes')`` ->
-        ``FROM DataXProcessedInput_5minutes`` + window conf.
-        reference: Engine.cs:597-630"""
+        """``FROM <table> TIMEWINDOW('5 minutes')`` ->
+        ``FROM <table>_5minutes`` + window conf.
+        reference: Engine.cs:597-630 — which restricts windows to
+        DataXProcessedInput in FROM position; here ANY projected table
+        may be windowed, in FROM or JOIN position (multi-source flows
+        window the joined stream's table, the cross-stream
+        sliding-window-join shape; the engine validates the table name
+        at compile time). One TIMEWINDOW per statement."""
         windows: Dict[str, str] = {}
         pattern = re.compile(
-            r"--DataXQuery--\s*([^;]*?)FROM\s+(\S+)(\s+)TIMEWINDOW\s*\(\s*(.*?)\s*\)\s*([^;]*?)",
+            r"--DataXQuery--\s*([^;]*?(?:FROM|JOIN)\s+)(\S+)(\s+)"
+            r"TIMEWINDOW\s*\(\s*(.*?)\s*\)\s*([^;]*?)",
             re.I,
         )
-        for m in list(pattern.finditer(self._code)):
+        # fixpoint scan: a statement windowing BOTH join sides needs two
+        # passes (the lazy prefix reaches the next TIMEWINDOW once the
+        # first is rewritten)
+        while True:
+            m = pattern.search(self._code)
+            if m is None:
+                break
             window_str = m.group(4).strip().replace("'", "")
             src_table = m.group(2).strip()
-            if src_table.lower() != DEFAULT_TARGET.lower():
+            if (
+                self._windowable is not None
+                and src_table.lower() not in self._windowable
+            ):
                 raise ValueError(
-                    f"'{DEFAULT_TARGET}' is the only table for which the "
-                    "TIMEWINDOW can be specified"
+                    f"TIMEWINDOW target '{src_table}' is not a projected "
+                    f"input table (windowable: "
+                    f"{sorted(self._windowable)})"
                 )
             new_table = src_table + "_" + window_str.replace(" ", "")
-            new_query = re.sub(
-                rf"\b{DEFAULT_TARGET}\b", new_table, m.group(0), flags=re.I
-            )
+            # replace ONLY the matched table occurrence (a blanket
+            # case-insensitive word substitution would also rename
+            # same-named columns/aliases in the statement)
+            g0 = m.group(0)
+            t_start = m.start(2) - m.start(0)
+            t_end = m.end(2) - m.start(0)
+            new_query = g0[:t_start] + new_table + g0[t_end:]
             new_query = new_query.replace(m.group(4).strip(), "")
-            new_query = re.sub(r"TIMEWINDOW\s*\(\s*\)\s*", "", new_query, flags=re.I)
+            new_query = re.sub(
+                r"TIMEWINDOW\s*\(\s*\)\s*", "", new_query, flags=re.I
+            )
             windows.setdefault(new_table, window_str)
-            self._code = self._code.replace(m.group(0), new_query)
+            self._code = (
+                self._code[: m.start(0)] + new_query
+                + self._code[m.end(0):]
+            )
         return windows
 
     def _generate_metrics_config(self, outputs: List[Tuple[str, str]]) -> dict:

@@ -951,6 +951,8 @@ class ExprCompiler:
                 deps=tuple(d for v in vals for d in v.deps),
             )
         if name in ("POW", "POWER"):
+            if len(e.args) != 2:
+                raise EngineException(f"{name} takes exactly two arguments")
             base_v = self._as_device(e.args[0])
             exp_v = self._as_device(e.args[1])
             _promote(base_v.type, exp_v.type)  # rejects strings/booleans mix
@@ -965,6 +967,8 @@ class ExprCompiler:
                 deps=base_v.deps + exp_v.deps,
             )
         if name == "MOD":
+            if len(e.args) != 2:
+                raise EngineException("MOD takes exactly two arguments")
             # delegate to the '%' operator path: same promotion, same
             # string guard, same truncated-modulo semantics
             return self._arith(

@@ -335,6 +335,18 @@ class FlowProcessor:
         )
         global_projection = process_conf.get_string_seq_option("projection")
         if source_groups:
+            # the flow's main input (input.default.*) joins the map as
+            # the primary source when it is declared and the sources map
+            # doesn't name its own "default" — the designer's model is
+            # "main input + additional sources"
+            if (
+                DEFAULT_SOURCE not in source_groups
+                and input_conf.get("blobschemafile")
+            ):
+                self.specs[DEFAULT_SOURCE] = self._make_spec(
+                    DEFAULT_SOURCE, input_conf, default_capacity,
+                    global_projection,
+                )
             for sname, sub in source_groups.items():
                 self.specs[sname] = self._make_spec(
                     sname, sub, default_capacity,

@@ -206,6 +206,51 @@ class RuntimeConfigGeneration:
         )
         tok.set("inputSchemaFilePath", self.runtime.stored_path(schema_path))
 
+        # additional named input sources (gui.input.sources — the
+        # flattenerConfig input.sources map): each gets its own schema/
+        # projection artifact and flat datax.job.input.sources.<name>.*
+        # keys, enabling multi-source flows (cross-stream window joins)
+        # straight from the designer
+        ctx["multi_source_keys"] = {}
+        for src in (gui.get("input") or {}).get("sources") or []:
+            sname = src.get("id") or src.get("name")
+            if not sname:
+                continue
+            if not re.fullmatch(r"[A-Za-z][A-Za-z0-9_-]*", sname):
+                # the id becomes a file path segment and a flat conf-key
+                # namespace: anything else is a traversal / key-injection
+                # vector
+                raise ValueError(
+                    f"source id {sname!r} must match [A-Za-z][A-Za-z0-9_-]*"
+                )
+            sprops = src.get("properties") or {}
+            ns = f"datax.job.input.sources.{sname}"
+            keys = ctx["multi_source_keys"]
+            keys[f"{ns}.inputtype"] = (src.get("type") or "local").lower()
+            sschema = sprops.get("inputSchemaFile") or "{}"
+            spath = os.path.join(ctx["flow_dir"], "sources",
+                                 f"{sname}.schema.json")
+            ctx["result"].files[spath] = (
+                sschema if isinstance(sschema, str) else json.dumps(sschema)
+            )
+            keys[f"{ns}.blobschemafile"] = self.runtime.stored_path(spath)
+            if sprops.get("target"):
+                keys[f"{ns}.target"] = sprops["target"]
+            snippet = sprops.get("normalizationSnippet")
+            if snippet:
+                ppath = os.path.join(ctx["flow_dir"], "sources",
+                                     f"{sname}.projection")
+                ctx["result"].files[ppath] = snippet
+                keys[f"{ns}.projection"] = self.runtime.stored_path(ppath)
+            # remaining scalar properties pass through lowercased
+            # (kafka.topics, socket.port, maxRate, ...)
+            for pk, pv in sprops.items():
+                if pk in ("inputSchemaFile", "target",
+                          "normalizationSnippet") or pv in (None, "", [], {}):
+                    continue
+                if isinstance(pv, (str, int, float, bool)):
+                    keys[f"{ns}.{pk.lower()}"] = str(pv)
+
         # reference data passes straight through as the template value
         tok.set("inputReferenceData", [
             {
@@ -226,8 +271,15 @@ class RuntimeConfigGeneration:
         queries = (gui.get("process") or {}).get("queries") or []
         code = "\n".join(q if isinstance(q, str) else str(q) for q in queries)
         rules_json = self.rule_gen.generate(gui.get("rules") or [], doc["name"])
+        windowable = {"DataXProcessedInput"}
+        for src in (gui.get("input") or {}).get("sources") or []:
+            sname = src.get("id") or src.get("name")
+            if sname:
+                windowable.add(
+                    (src.get("properties") or {}).get("target") or sname
+                )
         rules_code: RulesCode = self.codegen.generate_code(
-            code, rules_json, doc["name"]
+            code, rules_json, doc["name"], windowable_tables=windowable
         )
         ctx["rules_code"] = rules_code
 
@@ -416,6 +468,7 @@ class RuntimeConfigGeneration:
                 for k, v in b.items():
                     if v:
                         extra[f"{ns}.{k.lower()}"] = str(v)
+            extra.update(ctx.get("multi_source_keys") or {})
             flat.update(extra)
             conf_text = "\n".join(f"{k}={v}" for k, v in sorted(flat.items()))
             ctx["flat_confs"].append((job_name, conf_text))

@@ -192,6 +192,37 @@ route("#/flow/", async (view, hash) => {
         render(); toast("schema inferred from sample");
       },
     }, "Infer schema from sample"));
+    // additional named sources (multi-source flows: each projects into
+    // its own table; TIMEWINDOW over any table enables cross-stream
+    // sliding-window joins)
+    gui.input.sources = gui.input.sources || [];
+    const srcs = gui.input.sources;
+    const srcList = h("div", {});
+    const renderSrcs = () => {
+      srcList.replaceChildren(...srcs.map((sr, i) => {
+        sr.properties = sr.properties || {};
+        return h("div", { class: "card" },
+          field(sr, "id", "Source name", { ph: "weather" }),
+          field(sr, "type", "Input type",
+            { options: ["local", "socket", "file", "kafka", "eventhub-kafka"] }),
+          field(sr.properties, "target", "Projected table",
+            { ph: "Weather (defaults to the source name)" }),
+          area(sr.properties, "inputSchemaFile", "Schema (JSON)"),
+          area(sr.properties, "normalizationSnippet", "Normalization"),
+          h("button", {
+            class: "ghost danger",
+            onclick: () => { srcs.splice(i, 1); renderSrcs(); },
+          }, "remove source"));
+      }));
+    };
+    renderSrcs();
+    pane.append(
+      h("h3", {}, "Additional sources"),
+      srcList,
+      h("button", {
+        class: "ghost",
+        onclick: () => { srcs.push({ id: "", type: "local", properties: {} }); renderSrcs(); },
+      }, "+ add source"));
   } else if (tab === "query") {
     // gui contract: process.queries is a list of script chunks
     const qobj = { text: (gui.process.queries || []).join("\n") };

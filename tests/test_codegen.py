@@ -179,3 +179,36 @@ def test_rule_helpers_backtick_and_dots():
     )
     assert r.condition_to_sql() == "`device.msg.received_min` > 1"
     assert r.pivots_to_template() == "'device.status.home', home"
+
+
+def test_timewindow_join_position_and_precision():
+    """TIMEWINDOW in JOIN position rewrites only the matched table
+    occurrence (a same-named column must survive), both join sides may
+    window, and an unknown table fails loudly when a windowable set is
+    given."""
+    import pytest
+
+    from data_accelerator_tpu.compile.codegen import CodegenEngine
+
+    eng = CodegenEngine()
+    code = (
+        "--DataXQuery--\n"
+        "S = SELECT d.weather, w.windSpeed FROM Doors TIMEWINDOW('5 seconds') d "
+        "INNER JOIN Weather TIMEWINDOW('10 seconds') w "
+        "ON d.deviceId = w.stationId;"
+    )
+    rc = eng.generate_code(code, "[]", "P",
+                           windowable_tables={"Doors", "Weather"})
+    assert rc.time_windows == {
+        "Doors_5seconds": "5 seconds", "Weather_10seconds": "10 seconds",
+    }
+    assert "FROM Doors_5seconds d" in rc.code
+    assert "JOIN Weather_10seconds w" in rc.code
+    assert "d.weather" in rc.code  # column named like the table survives
+    assert "TIMEWINDOW" not in rc.code
+
+    with pytest.raises(ValueError, match="not a projected"):
+        eng.generate_code(
+            "--DataXQuery--\nS = SELECT * FROM Typo TIMEWINDOW('5 seconds');",
+            "[]", "P", windowable_tables={"DataXProcessedInput"},
+        )
