@@ -102,10 +102,19 @@ class ArrayValue:
     elements: List["Value"]
 
 
+# reserved literal prefix marking a CONCAT_WS deferred template: the
+# marker part carries the separator, every following part is ONE
+# argument (null arguments are skipped at materialization, Spark
+# concat_ws semantics). "\x00" cannot occur in user literals.
+WS_MARKER = "\x00ws:"
+
+
 @dataclass
 class HostStr:
     """Deferred string expression: parts are literal strs or CompiledExpr
-    whose device value gets decoded/stringified on the host at sink time."""
+    whose device value gets decoded/stringified on the host at sink time.
+    A first part starting with ``WS_MARKER`` switches the template to
+    concat_ws (skip-null) rendering."""
 
     parts: List[Union[str, CompiledExpr]]
     deps: Tuple[Tuple[str, str], ...] = ()
@@ -394,6 +403,11 @@ class ExprCompiler:
         if is_device(v) and v.type == "string":
             parts: List[Union[str, CompiledExpr]] = [v]
         elif isinstance(v, HostStr):
+            if v.parts and isinstance(v.parts[0], str) \
+                    and v.parts[0].startswith(WS_MARKER):
+                # concat_ws skips null arguments — a rolling hash over
+                # fixed parts cannot express that; no device tier
+                return None
             parts = []
             for p in v.parts:
                 if isinstance(p, str):
@@ -805,6 +819,11 @@ class ExprCompiler:
             for a in e.args:
                 v = self.compile(a)
                 if isinstance(v, HostStr):
+                    if v.parts and isinstance(v.parts[0], str) \
+                            and v.parts[0].startswith(WS_MARKER):
+                        raise EngineException(
+                            "CONCAT over a CONCAT_WS result is not supported"
+                        )
                     parts.extend(v.parts)
                     deps += v.deps
                 elif isinstance(v, CompiledExpr):
@@ -1220,17 +1239,25 @@ class ExprCompiler:
                 "SPLIT_PART(s, d, i) to take one element"
             )
         if name == "CONCAT_WS":
+            # Spark concat_ws SKIPS null arguments (and their
+            # separators) instead of nulling the result like CONCAT, so
+            # the deferred template keeps per-ARGUMENT structure: a
+            # marker literal carries the separator and every following
+            # part is one argument. The materializer joins the non-null
+            # renders; nested computed-string arguments would lose their
+            # grouping in this representation, so they are rejected.
             sep = self._const_str(args[0], "CONCAT_WS separator")
-            parts: List[Union[str, CompiledExpr]] = []
+            parts: List[Union[str, CompiledExpr]] = [WS_MARKER + sep]
             deps: Tuple[Tuple[str, str], ...] = ()
-            for i, a in enumerate(args[1:]):
-                if i:
-                    parts.append(sep)
+            for a in args[1:]:
                 v = self.compile(a)
                 if isinstance(v, HostStr):
-                    parts.extend(v.parts)
-                    deps += v.deps
-                elif isinstance(v, CompiledExpr):
+                    raise EngineException(
+                        "CONCAT_WS over computed-string arguments is not "
+                        "supported; CONCAT the pieces first or pass "
+                        "plain columns/literals"
+                    )
+                if isinstance(v, CompiledExpr):
                     if isinstance(a, Literal) and a.kind == "str":
                         parts.append(a.value)
                     else:

@@ -71,6 +71,28 @@ class Pipeline:
     def schema_of(self, name: str) -> ViewSchema:
         return self.catalog[name]
 
+    def view_by_name(self, name: str) -> Optional[CompiledView]:
+        # LAST definition wins, matching run()'s env overwrite and the
+        # catalog (a reassigned view name must not resolve to the stale
+        # first definition's host-order metadata)
+        for v in reversed(self.views):
+            if v.name == name:
+                return v
+        return None
+
+
+def _referenced_tables(sel) -> List[str]:
+    """Table names a parsed select reads (FROM/JOIN, union branches)."""
+    out: List[str] = []
+    cur = sel
+    while cur is not None:
+        if cur.from_table is not None:
+            out.append(cur.from_table.name)
+        for j in cur.joins:
+            out.append(j.table.name)
+        cur = cur.union
+    return out
+
 
 _DDL_COL_RE = re.compile(r"\s*(`[^`]+`|[A-Za-z_][\w.]*)\s+([A-Za-z]+)\s*$")
 
@@ -149,6 +171,7 @@ class PipelineCompiler:
             state_names.append(name)
 
         views: List[CompiledView] = []
+        host_limited: Dict[str, str] = {}  # view name -> why
         for cmd in parsed.commands:
             if cmd.command_type != COMMAND_TYPE_QUERY or cmd.name is None:
                 # bare commands (CACHE TABLE etc.) are execution hints the
@@ -156,11 +179,27 @@ class PipelineCompiler:
                 # subsumes caching decisions
                 continue
             sel = parse_select(cmd.text)
+            # a LIMIT deferred to host ordering only applies at output
+            # materialization — a later statement reading that view would
+            # silently see ALL rows, so the reference must fail loudly
+            for ref in _referenced_tables(sel):
+                if ref in host_limited:
+                    raise EngineException(
+                        f"view '{ref}' uses LIMIT with ORDER BY on a "
+                        "computed-string column, which applies at output "
+                        "materialization; it cannot feed statement "
+                        f"'{cmd.name}' — order/limit in the final "
+                        "statement instead"
+                    )
             compiler = SelectCompiler(
                 catalog, capacities, self.dictionary, self.udfs, self.config,
                 aux=self.aux,
             )
             view = compiler.compile_select(cmd.name, sel)
+            if view.host_order and view.host_limit is not None:
+                host_limited[view.name] = "host-limited"
+            elif view.name in host_limited:
+                host_limited.pop(view.name)  # reassigned without limit
             views.append(view)
             catalog[view.name] = view.schema
             capacities[view.name] = view.capacity

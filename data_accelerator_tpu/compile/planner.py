@@ -151,6 +151,11 @@ class CompiledView:
     # select list in declaration order, for ORDER BY <ordinal> binding
     # (None for views not built from a select list, e.g. inputs)
     select_values: Optional[List[Tuple[str, Value]]] = None
+    # ORDER BY keys naming deferred (computed-string) output columns
+    # cannot sort on device; the runtime applies this ordering (+ limit)
+    # on the materialized host rows instead — [(column, ascending)]
+    host_order: Optional[List[Tuple[str, bool]]] = None
+    host_limit: Optional[int] = None
 
 
 # ---------------------------------------------------------------------------
@@ -920,6 +925,44 @@ class SelectCompiler:
         # keys: (CompiledExpr, ascending)
         keys: List[Tuple[CompiledExpr, bool]] = []
         from .sqlparser import Literal as _Lit
+
+        # host-order path: a key NAMING a deferred (computed-string)
+        # output column has no device representation to sort by. When
+        # every key is a plain output-column reference (or ordinal),
+        # the whole ordering + limit moves to the host, applied to the
+        # materialized rows — Spark-composable ORDER BY on CONCAT/CAST
+        # results, at host cost for only the rows that cross the
+        # boundary. Keys that EMBED a deferred column in a larger
+        # expression still fail below.
+        def _plain_name(expr) -> Optional[str]:
+            if (
+                isinstance(expr, _Lit) and expr.kind == "int"
+                and select_values and 1 <= expr.value <= len(select_values)
+            ):
+                return select_values[expr.value - 1][0]
+            if isinstance(expr, Col) and len(expr.parts) == 1:
+                return expr.parts[0]
+            return None
+
+        plain_names = [_plain_name(i.expr) for i in order_by]
+        if any(n in view.schema.deferred for n in plain_names if n):
+            if all(
+                n and (n in view.schema.deferred or n in view.schema.types)
+                for n in plain_names
+            ):
+                return replace(
+                    view,
+                    host_order=[
+                        (n, i.ascending)
+                        for n, i in zip(plain_names, order_by)
+                    ],
+                    host_limit=limit,
+                )
+            raise EngineException(
+                "ORDER BY mixing a computed-string column with "
+                "non-column expressions is not supported; order by the "
+                "output columns directly"
+            )
 
         for item in order_by:
             expr = item.expr

@@ -13,6 +13,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..compile.exprs import WS_MARKER
 from ..compile.planner import TableData, ViewSchema
 from ..core.schema import StringDictionary
 
@@ -29,15 +30,6 @@ def _render_value(v, t: str, dictionary: StringDictionary, base_ms: int):
     if t == "double":
         return float(v)
     return int(v)
-
-
-def _stringify(v, t: str, dictionary: StringDictionary, base_ms: int):
-    rendered = _render_value(v, t, dictionary, base_ms)
-    if t == "double":
-        # integral doubles print bare (Spark's CONCAT of a long behaves so;
-        # doubles keep one decimal)
-        return f"{rendered:g}"
-    return str(rendered)
 
 
 def materialize_rows(
@@ -67,16 +59,40 @@ def materialize_rows(
                 continue
             v = _render_value(cols[c][i], schema.types[c], dictionary, base_ms)
             _bury(row, c, v)
-        # deferred string templates
+        # deferred string templates. CONCAT: a NULL part nulls the
+        # whole result (matching the device hash tier). CONCAT_WS
+        # (WS_MARKER-tagged): null ARGUMENTS are skipped and the rest
+        # join on the separator — both per Spark semantics.
         for name, parts in schema.deferred.items():
+            ws_sep = None
+            if parts and isinstance(parts[0], str) \
+                    and parts[0].startswith(WS_MARKER):
+                ws_sep = parts[0][len(WS_MARKER):]
+                parts = parts[1:]
             pieces = []
             for p in parts:
                 if isinstance(p, str):
                     pieces.append(p)
-                else:
-                    hidden, t = p
-                    pieces.append(_stringify(cols[hidden][i], t, dictionary, base_ms))
-            _bury(row, name, "".join(pieces))
+                    continue
+                hidden, t = p
+                rendered = _render_value(
+                    cols[hidden][i], t, dictionary, base_ms
+                )
+                if rendered is None:
+                    if ws_sep is not None:
+                        continue  # concat_ws skips null arguments
+                    pieces = None
+                    break
+                pieces.append(
+                    f"{rendered:g}" if t == "double" else str(rendered)
+                )
+            if pieces is None:
+                value = None
+            elif ws_sep is not None:
+                value = ws_sep.join(pieces)
+            else:
+                value = "".join(pieces)
+            _bury(row, name, value)
         # array/struct validity: drop nulled-out branches
         row = _apply_validity(row, cols, schema, i)
         out.append(row)

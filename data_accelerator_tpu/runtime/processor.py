@@ -1141,6 +1141,20 @@ class FlowProcessor:
             st.persist()
 
 
+def _host_sort(rows: List[dict], order: List[Tuple[str, bool]]) -> None:
+    """Stable multi-key in-place sort matching SQL semantics: ascending
+    puts NULLs first, descending puts them last (Spark defaults).
+    Applied least-significant key first so significance composes."""
+    for key, asc in reversed(order):
+        def kf(r, k=key):
+            v = r.get(k)
+            # the second element only compares within equal null-flags,
+            # so the placeholder never meets a real value
+            return (v is not None, v if v is not None else 0)
+
+        rows.sort(key=kf, reverse=not asc)
+
+
 # batches at or below this capacity fetch counts + whole outputs in one
 # device_get instead of syncing counts first and slicing on device —
 # one host<->device round-trip instead of two (latency mode)
@@ -1268,10 +1282,19 @@ class PendingBatch:
 
         datasets: Dict[str, List[dict]] = {}
         for name, table in host_tables.items():
-            datasets[name] = materialize_rows(
+            rows = materialize_rows(
                 table, self.pipeline.schema_of(name), proc.dictionary,
                 self.base_ms,
             )
+            view = self.pipeline.view_by_name(name)
+            if view is not None and view.host_order:
+                # ORDER BY over computed-string columns: the device has
+                # no id to sort by, so the ordering (and limit) applies
+                # to the materialized rows (planner host-order path)
+                _host_sort(rows, view.host_order)
+                if view.host_limit is not None:
+                    rows = rows[: view.host_limit]
+            datasets[name] = rows
 
         # persist state tables (A/B overwrite; persist() is the caller's
         # post-sink commit, see StreamingHost) — from THIS batch's state

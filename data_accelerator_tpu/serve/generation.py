@@ -243,13 +243,26 @@ class RuntimeConfigGeneration:
                 ctx["result"].files[ppath] = snippet
                 keys[f"{ns}.projection"] = self.runtime.stored_path(ppath)
             # remaining scalar properties pass through lowercased
-            # (kafka.topics, socket.port, maxRate, ...)
+            # (kafka.topics, socket.port, maxRate, ...) — key charset
+            # restricted and newlines rejected: conf is line-based
+            # key=value text, so either would inject arbitrary lines
             for pk, pv in sprops.items():
                 if pk in ("inputSchemaFile", "target",
                           "normalizationSnippet") or pv in (None, "", [], {}):
                     continue
-                if isinstance(pv, (str, int, float, bool)):
-                    keys[f"{ns}.{pk.lower()}"] = str(pv)
+                if not isinstance(pv, (str, int, float, bool)):
+                    continue
+                if not re.fullmatch(r"[A-Za-z0-9_.-]+", pk):
+                    raise ValueError(
+                        f"source property key {pk!r} must match "
+                        "[A-Za-z0-9_.-]+"
+                    )
+                sv = str(pv)
+                if "\n" in sv or "\r" in sv:
+                    raise ValueError(
+                        f"source property {pk!r} value must be single-line"
+                    )
+                keys[f"{ns}.{pk.lower()}"] = sv
 
         # reference data passes straight through as the template value
         tok.set("inputReferenceData", [

@@ -252,10 +252,25 @@ class DataXApi:
         }
 
     def _userquery_codegen(self, body, query):
+        # live validation must match generation: TIMEWINDOW targets
+        # check against the saved flow's projected tables when known
+        windowable = None
+        name = body.get("name") or ""
+        doc = self.flow_ops.get_flow(name) if name else None
+        if doc:
+            windowable = {"DataXProcessedInput"}
+            gui = doc.get("gui") or {}
+            for src in (gui.get("input") or {}).get("sources") or []:
+                sname = src.get("id") or src.get("name")
+                if sname:
+                    windowable.add(
+                        (src.get("properties") or {}).get("target") or sname
+                    )
         rc = self.codegen.generate_code(
             body.get("query") or "",
             json.dumps(body.get("rules") or []),
-            body.get("name") or "",
+            name,
+            windowable_tables=windowable,
         )
         return {
             "code": rc.code,

@@ -269,3 +269,113 @@ def test_high_cardinality_stress_unbounded_dictionary():
     )
     assert [r["n"] for r in rows] == [n - 1]
     assert len(dd) > n  # originals + uppercased images
+
+
+def test_order_by_computed_string_end_to_end(tmp_path):
+    """ORDER BY over a CONCAT alias sorts the materialized rows (host
+    path): ascending NULLS FIRST, descending NULLS LAST, LIMIT applies
+    after the sort — Spark semantics."""
+    from data_accelerator_tpu.runtime.processor import FlowProcessor
+
+    schema = json.dumps({"type": "struct", "fields": [
+        {"name": "cluster", "type": "string", "nullable": True, "metadata": {}},
+        {"name": "node", "type": "string", "nullable": True, "metadata": {}},
+    ]})
+    rows_in = [
+        {"cluster": "east", "node": "b"},
+        {"cluster": "east", "node": "a"},
+        {"cluster": None, "node": "x"},
+        {"cluster": "west", "node": "a"},
+    ]
+
+    def proc_for(query):
+        t = tmp_path / f"{abs(hash(query))}.transform"
+        t.write_text("--DataXQuery--\n" + query + "\n")
+        return FlowProcessor(
+            SettingDictionary({
+                "datax.job.name": "OrdDef",
+                "datax.job.input.default.blobschemafile": schema,
+                "datax.job.process.transform": str(t),
+                "datax.job.process.timestampcolumn": "eventTimeStamp",
+                "datax.job.process.batchcapacity": "8",
+            }),
+            output_datasets=["Out"],
+        )
+
+    base = 1_700_000_000_000
+    proc = proc_for(
+        "Out = SELECT CONCAT(cluster, '/', node) AS tag, node "
+        "FROM DataXProcessedInput ORDER BY tag"
+    )
+    datasets, _ = proc.process_batch(proc.encode_rows(rows_in, base), base)
+    assert [r["tag"] for r in datasets["Out"]] == [
+        None, "east/a", "east/b", "west/a",
+    ]
+
+    proc = proc_for(
+        "Out = SELECT CONCAT(cluster, '/', node) AS tag, node "
+        "FROM DataXProcessedInput ORDER BY tag DESC LIMIT 2"
+    )
+    datasets, _ = proc.process_batch(proc.encode_rows(rows_in, base), base)
+    assert [r["tag"] for r in datasets["Out"]] == ["west/a", "east/b"]
+
+
+def test_concat_ws_skips_null_arguments():
+    """Spark concat_ws: null arguments (and their separators) are
+    skipped — the result nulls only when everything is null-ish."""
+    cols = {"a": ["x", None, None], "b": ["y", "z", None], "n": [0, 1, 2]}
+    tt = {"a": "string", "b": "string", "n": "long"}
+    rows, _, _ = run_sql(
+        "SELECT CONCAT_WS('-', a, b) AS t, n FROM T", {"T": (cols, tt)},
+    )
+    # run_sql skips deferred cols; go through the processor for values
+    from data_accelerator_tpu.runtime.processor import FlowProcessor
+    import tempfile, os
+    schema = json.dumps({"type": "struct", "fields": [
+        {"name": "a", "type": "string", "nullable": True, "metadata": {}},
+        {"name": "b", "type": "string", "nullable": True, "metadata": {}},
+    ]})
+    d = tempfile.mkdtemp()
+    with open(os.path.join(d, "t.transform"), "w") as f:
+        f.write("--DataXQuery--\n"
+                "Out = SELECT CONCAT_WS('-', a, b) AS t FROM DataXProcessedInput\n")
+    proc = FlowProcessor(SettingDictionary({
+        "datax.job.name": "WS",
+        "datax.job.input.default.blobschemafile": schema,
+        "datax.job.process.transform": os.path.join(d, "t.transform"),
+        "datax.job.process.timestampcolumn": "eventTimeStamp",
+        "datax.job.process.batchcapacity": "8",
+    }), output_datasets=["Out"])
+    base = 1_700_000_000_000
+    datasets, _ = proc.process_batch(proc.encode_rows(
+        [{"a": "x", "b": "y"}, {"a": None, "b": "z"},
+         {"a": None, "b": None}], base), base)
+    assert [r["t"] for r in datasets["Out"]] == ["x-y", "z", ""]
+
+
+def test_host_limited_view_cannot_feed_later_statement(tmp_path):
+    """A computed-string ORDER BY + LIMIT applies at output; a later
+    statement reading that view must fail at compile, not silently see
+    all rows."""
+    from data_accelerator_tpu.runtime.processor import FlowProcessor
+
+    schema = json.dumps({"type": "struct", "fields": [
+        {"name": "cluster", "type": "string", "nullable": True, "metadata": {}},
+        {"name": "node", "type": "string", "nullable": True, "metadata": {}},
+    ]})
+    t = tmp_path / "t.transform"
+    t.write_text(
+        "--DataXQuery--\n"
+        "Mid = SELECT CONCAT(cluster, '/', node) AS tag "
+        "FROM DataXProcessedInput ORDER BY tag LIMIT 2\n"
+        "--DataXQuery--\n"
+        "Out = SELECT tag FROM Mid\n"
+    )
+    with pytest.raises(EngineException, match="materialization"):
+        FlowProcessor(SettingDictionary({
+            "datax.job.name": "HL",
+            "datax.job.input.default.blobschemafile": schema,
+            "datax.job.process.transform": str(t),
+            "datax.job.process.timestampcolumn": "eventTimeStamp",
+            "datax.job.process.batchcapacity": "8",
+        }), output_datasets=["Out"])

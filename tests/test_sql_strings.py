@@ -516,13 +516,15 @@ def test_order_by_prefers_output_alias_over_source():
 
 def test_order_by_ordinal_counts_deferred_items():
     """ORDER BY <ordinal> counts ALL select items; a deferred-string
-    target raises instead of silently binding the next device column."""
+    target compiles to the HOST-order path (the runtime sorts the
+    materialized rows) instead of silently binding the next device
+    column."""
     cols = {"a": [3, 1, 2], "b": ["p", "q", "r"]}
     types = {"a": "long", "b": "string"}
-    with pytest.raises(EngineException, match="deferred string"):
-        run_select(
-            "SELECT CONCAT(b, '!') AS c, a FROM T ORDER BY 1", cols, types
-        )
+    _rows, view, _ = run_select(
+        "SELECT CONCAT(b, '!') AS c, a FROM T ORDER BY 1", cols, types
+    )
+    assert view.host_order == [("c", True)]
     # ordinal 2 is the device column a
     rows, _, _ = run_select(
         "SELECT CONCAT(b, '!') AS c, a FROM T ORDER BY 2", cols, types
@@ -590,15 +592,16 @@ def test_stringmap_cascade_strict_and_rounds(caplog):
     assert dd.decode(rid) == "YZYZYZCBA"
 
 
-def test_order_by_deferred_alias_shadowing_source_column_errors():
+def test_order_by_deferred_alias_shadowing_source_column():
     """An alias bound to a deferred string expression must not fall
-    back to a same-named source column it shadows."""
+    back to a same-named source column it shadows — it binds the
+    computed column via the host-order path."""
     cols = {"b": ["a", "b"], "c": ["2", "1"], "n": [10, 20]}
     types = {"b": "string", "c": "string", "n": "long"}
-    with pytest.raises(EngineException, match="deferred"):
-        run_select(
-            "SELECT CONCAT(c, b) AS b, n FROM T ORDER BY b", cols, types
-        )
+    _rows, view, _ = run_select(
+        "SELECT CONCAT(c, b) AS b, n FROM T ORDER BY b", cols, types
+    )
+    assert view.host_order == [("b", True)]
 
 
 def test_order_by_unresolvable_key_mentions_both_scopes():
