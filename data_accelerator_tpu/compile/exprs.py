@@ -1004,20 +1004,21 @@ class ExprCompiler:
                 lambda env, v=v: jnp.sign(v.fn(env)).astype(jnp.float32),
                 deps=v.deps,
             )
-        if name in ("ABS", "FLOOR", "CEIL", "ROUND", "SQRT", "EXP", "LOG"):
+        if name in ("ABS", "FLOOR", "CEIL", "ROUND", "SQRT", "EXP", "LOG",
+                    "LOG10", "LOG2", "CBRT"):
             v = self._as_device(e.args[0])
             jf = {
                 "ABS": jnp.abs, "FLOOR": jnp.floor, "CEIL": jnp.ceil,
                 "ROUND": jnp.round, "SQRT": jnp.sqrt, "EXP": jnp.exp,
-                "LOG": jnp.log,
+                "LOG": jnp.log, "LOG10": jnp.log10, "LOG2": jnp.log2,
+                "CBRT": jnp.cbrt,
             }[name]
-            out_t = v.type if name == "ABS" else (
-                "double" if name in ("SQRT", "EXP", "LOG") else v.type
-            )
+            always_double = ("SQRT", "EXP", "LOG", "LOG10", "LOG2", "CBRT")
+            out_t = "double" if name in always_double else v.type
 
             def run(env, v=v, jf=jf, out_t=out_t):
                 x = v.fn(env)
-                if jf in (jnp.floor, jnp.ceil, jnp.round, jnp.sqrt, jnp.exp, jnp.log):
+                if jf is not jnp.abs:
                     x = x.astype(jnp.float32)
                 return _to_dtype(jf(x), out_t)
 
@@ -1203,6 +1204,19 @@ class ExprCompiler:
             return self._string_map(
                 name, args[0], f"REGEXP_REPLACE:{pat!r}:{repl!r}",
                 lambda s, rx=rx, r=py_repl: rx.sub(r, s),
+            )
+        if name == "REPEAT":
+            times = self._const_int(args[1], "REPEAT count")
+            return self._string_map(
+                name, args[0], f"REPEAT:{times}",
+                lambda s, t=times: s * max(t, 0),
+            )
+        if name == "ASCII":
+            # scalar tables are int32 and carry no NULL slot: NULL in ->
+            # 0 out, the engine-wide scalar-table convention (LENGTH
+            # shares it); Spark returns NULL here
+            return self._string_scalar(
+                "ASCII", args[0], "ASCII", lambda s: ord(s[0]) if s else 0
             )
         if name in ("LPAD", "RPAD"):
             ln = self._const_int(args[1], f"{name} length")

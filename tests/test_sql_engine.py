@@ -412,3 +412,23 @@ def test_numeric_scalar_functions():
         "SELECT GREATEST(n, a, 8.1) AS g FROM T", {"T": (T, TT)}
     )
     assert [round(r["g"], 4) for r in rows] == [8.1, 8.1, 9.0]
+
+
+def test_more_scalar_functions():
+    """REPEAT/ASCII (dictionary tables) and LOG10/LOG2/CBRT."""
+    from test_computed_strings import run_sql
+
+    T = {"s": ["ab", "", None], "a": [100.0, 8.0, 27.0], "n": [0, 1, 2]}
+    TT = {"s": "string", "a": "double", "n": "long"}
+    rows, _, dd = run_sql(
+        "SELECT REPEAT(s, 2) AS r, ASCII(s) AS c, "
+        "LOG10(a) AS l10, LOG2(a) AS l2, CBRT(a) AS cb, n "
+        "FROM T", {"T": (T, TT)},
+    )
+    by_n = {r["n"]: r for r in rows}
+    assert by_n[0]["r"] == "abab" and by_n[0]["c"] == 97
+    assert by_n[1]["r"] == "" and by_n[1]["c"] == 0
+    assert by_n[2]["r"] is None  # NULL in -> NULL out
+    assert round(by_n[0]["l10"], 4) == 2.0
+    assert round(by_n[1]["l2"], 4) == 3.0
+    assert round(by_n[2]["cb"], 4) == 3.0
