@@ -178,14 +178,31 @@ class BatchHost:
         totals: Dict[str, float] = {"Batch_Files_Count": float(len(files))}
         rows: List[dict] = []
         batch_time_ms = int(t0 * 1000)
+        pending = None  # one chunk in flight (P6 overlap for batch mode)
 
-        def flush(chunk: List[dict]):
-            raw = self.processor.encode_rows(chunk, (batch_time_ms // 1000) * 1000)
-            datasets, metrics = self.processor.process_batch(raw, batch_time_ms)
+        def finish(handle) -> None:
+            datasets, metrics = handle.collect()
             self.dispatcher.dispatch(datasets, batch_time_ms)
             self.processor.commit()
             for k, v in metrics.items():
+                # counts sum across chunks; point-in-time / per-chunk
+                # latency values don't (a pipelined chunk's
+                # dispatch->collect span absorbs the NEXT chunk's file
+                # reads, and summing an epoch timestamp is meaningless)
+                if k in ("Latency-Process", "BatchProcessedET"):
+                    continue
                 totals[k] = totals.get(k, 0.0) + float(v)
+
+        def flush(chunk: List[dict]):
+            # dispatch chunk N, then finish chunk N-1 while N computes —
+            # same overlap as StreamingHost.run_pipelined, so file reads
+            # and sink writes hide under the device step
+            nonlocal pending
+            raw = self.processor.encode_rows(chunk, (batch_time_ms // 1000) * 1000)
+            handle = self.processor.dispatch_batch(raw, batch_time_ms)
+            if pending is not None:
+                finish(pending)
+            pending = handle
 
         try:
             for f in files:
@@ -195,6 +212,9 @@ class BatchHost:
                     rows = rows[cap:]
             if rows:
                 flush(rows)
+            if pending is not None:
+                finish(pending)
+                pending = None
         except Exception as e:
             self.telemetry.track_exception(e, {"event": "error/batch/process"})
             raise
@@ -202,6 +222,7 @@ class BatchHost:
         self._processed.update(files)
         if self.tracker_path:
             fs.write_text(self.tracker_path, "\n".join(sorted(self._processed)) + "\n")
+        totals["BatchProcessedET"] = float(batch_time_ms)
         totals["Latency-Batch"] = (time.time() - t0) * 1000.0
         self.metric_logger.send_batch_metrics(totals, batch_time_ms)
         self.telemetry.track_event(
