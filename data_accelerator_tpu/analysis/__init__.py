@@ -1,0 +1,44 @@
+"""Flow static analyzer: typed diagnostics over the whole flow graph.
+
+Design-time counterpart to the runtime compiler — reuses the production
+codegen + parsers so a bad flow config fails in milliseconds with a
+``DXnnn``-coded diagnostic instead of minutes into a deployed job.
+
+CLI: ``python -m data_accelerator_tpu.analysis flow.json [--json]``
+(non-zero exit on error-severity diagnostics).
+"""
+
+from .analyzer import (
+    DEFAULT_MAX_STATE_ROWS,
+    FlowAnalyzer,
+    FlowContext,
+    analyze_flow,
+    analyze_script,
+)
+from .diagnostics import (
+    CODES,
+    PASS_NAMES,
+    SEV_ERROR,
+    SEV_WARNING,
+    AnalysisReport,
+    Diagnostic,
+    Span,
+)
+from .typeprop import TableScope, schema_to_types
+
+__all__ = [
+    "AnalysisReport",
+    "CODES",
+    "DEFAULT_MAX_STATE_ROWS",
+    "Diagnostic",
+    "FlowAnalyzer",
+    "FlowContext",
+    "PASS_NAMES",
+    "SEV_ERROR",
+    "SEV_WARNING",
+    "Span",
+    "TableScope",
+    "analyze_flow",
+    "analyze_script",
+    "schema_to_types",
+]

@@ -1,0 +1,56 @@
+"""Flow static analyzer CLI.
+
+    python -m data_accelerator_tpu.analysis flow.json [flow2.json ...]
+        [--json]
+
+Each argument is a flow config file: either a designer gui JSON or a
+full flow document (``{"gui": {...}}``). Prints one line per diagnostic
+(or, with ``--json``, a machine-readable report per file) and exits
+non-zero when any file has error-severity diagnostics — the CI
+self-lint contract.
+
+Exit codes: 0 clean (warnings allowed) · 1 errors found · 2 usage/IO.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List
+
+from .analyzer import analyze_flow
+
+
+def main(argv: List[str]) -> int:
+    as_json = "--json" in argv
+    paths = [a for a in argv if not a.startswith("--")]
+    if not paths:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+
+    any_errors = False
+    json_out = []
+    for path in paths:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                flow = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"{path}: cannot read flow config: {e}", file=sys.stderr)
+            return 2
+        report = analyze_flow(flow)
+        any_errors |= not report.ok
+        if as_json:
+            json_out.append({"file": path, **report.to_dict()})
+        else:
+            for d in report.diagnostics:
+                print(f"{path}: {d.render()}")
+            n_e, n_w = len(report.errors), len(report.warnings)
+            print(f"{path}: {n_e} error(s), {n_w} warning(s)")
+    if as_json:
+        print(json.dumps(json_out if len(json_out) > 1 else json_out[0],
+                         indent=2))
+    return 1 if any_errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

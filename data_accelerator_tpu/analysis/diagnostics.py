@@ -1,0 +1,174 @@
+"""Typed diagnostics for the flow static analyzer.
+
+Every finding the analyzer emits is a ``Diagnostic`` carrying a stable
+``DXnnn`` code, a severity, the table (view) it concerns, a message and
+a source ``Span`` into the transform script that was analyzed. The code
+registry below is the single source of truth — ``ANALYSIS.md`` is
+generated from the same one-line cause/fix strings, and tests assert
+codes (not messages), so wording can improve without breaking callers.
+
+reference: the platform promise in PAPER.md §1 — design-time services
+(SqlParser/Analyzer, schema inference, codegen validation) catch a bad
+flow before the job is deployed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+
+
+@dataclass(frozen=True)
+class Span:
+    """1-based location in the analyzed transform script.
+
+    ``line`` is the first line of the statement; ``col`` is the 1-based
+    character offset within the statement text (statements are joined to
+    one logical line by the transform parser, so ``col`` indexes that
+    joined text); ``end_line`` closes multi-line statements.
+    """
+
+    line: int = 0
+    col: int = 1
+    end_line: Optional[int] = None
+
+    def to_dict(self) -> dict:
+        d = {"line": self.line, "col": self.col}
+        if self.end_line is not None:
+            d["endLine"] = self.end_line
+        return d
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    code: str  # "DX001"
+    severity: str  # SEV_ERROR | SEV_WARNING
+    table: str  # view/table the finding concerns ("" = flow-level)
+    message: str
+    span: Span = Span()
+
+    @property
+    def is_error(self) -> bool:
+        return self.severity == SEV_ERROR
+
+    def to_dict(self) -> dict:
+        return {
+            "code": self.code,
+            "severity": self.severity,
+            "table": self.table,
+            "message": self.message,
+            "span": self.span.to_dict(),
+        }
+
+    def render(self) -> str:
+        loc = f" (line {self.span.line})" if self.span.line else ""
+        tbl = f" [{self.table}]" if self.table else ""
+        return f"{self.severity.upper()} {self.code}{tbl} {self.message}{loc}"
+
+
+# ---------------------------------------------------------------------------
+# Code registry: code -> (default severity, one-line cause, one-line fix).
+# Pass 1 reference resolution DX00x · pass 2 type propagation DX01x ·
+# pass 3 aggregation/window legality DX02x · pass 4 dead flow DX03x ·
+# pass 5 device-compilation risk DX04x.
+# ---------------------------------------------------------------------------
+CODES: Dict[str, tuple] = {
+    # -- pass 1: reference resolution -----------------------------------
+    "DX001": (SEV_ERROR, "FROM/JOIN references a table no statement or input source defines",
+              "define the view earlier in the script, or declare the input source/TIMEWINDOW projecting it"),
+    "DX002": (SEV_ERROR, "column is not produced by any table in the statement's FROM scope",
+              "check spelling against the input schema / upstream view's select list"),
+    "DX003": (SEV_ERROR, "OUTPUT routes a dataset no transform statement produces",
+              "name an assigned view in the OUTPUT statement (the job would deploy producing nothing)"),
+    "DX004": (SEV_ERROR, "OUTPUT routes to a sink the flow's outputs section does not declare",
+              "add the sink under gui.outputs, or route to the built-in Metrics sink"),
+    "DX005": (SEV_ERROR, "view referenced before its definition (cyclic dependency)",
+              "reorder the statements, or back the cycle with a --DataXStates-- accumulation table"),
+    "DX006": (SEV_ERROR, "function is neither an engine builtin nor a declared UDF/UDAF",
+              "declare it under gui.process.functions or fix the name"),
+    "DX007": (SEV_ERROR, "duplicate output column name in one select list",
+              "alias one of the colliding select items"),
+    "DX008": (SEV_ERROR, "statement does not parse in the DataXQuery SQL subset",
+              "fix the syntax at the reported offset"),
+    "DX009": (SEV_ERROR, "TIMEWINDOW targets a table that is not a projected input",
+              "window the main projection or a declared source target table"),
+    # -- pass 2: type propagation ---------------------------------------
+    "DX010": (SEV_ERROR, "operands of a comparison/arithmetic op have incompatible types",
+              "cast one side explicitly, or compare like-typed columns"),
+    "DX011": (SEV_ERROR, "join keys on the two sides of ON have disagreeing types",
+              "cast one key, or join on like-typed columns"),
+    "DX012": (SEV_ERROR, "CAST of a literal that cannot convert to the target type",
+              "fix the literal or the CAST target"),
+    # -- pass 3: aggregation/window legality ----------------------------
+    "DX020": (SEV_ERROR, "aggregate function used outside an aggregation context (WHERE/ON/GROUP BY)",
+              "move the aggregate into the select list or HAVING of a GROUP BY statement"),
+    "DX021": (SEV_WARNING, "TIMEWINDOW retention exceeds the configured state capacity budget",
+              "shorten the window, raise the batch interval, or lower the batch capacity"),
+    "DX022": (SEV_ERROR, "accumulation table misuse: never updated, or update columns disagree with its DDL",
+              "assign the state table from a query whose output columns match the CREATE TABLE schema"),
+    # -- pass 4: dead flow ----------------------------------------------
+    "DX030": (SEV_WARNING, "view is computed but never reaches a sink, metric, accumulator or downstream view",
+              "OUTPUT it, reference it downstream, or delete the statement"),
+    "DX031": (SEV_WARNING, "flow routes nothing to any sink or accumulator",
+              "add an OUTPUT statement so the job produces something"),
+    # -- pass 5: device-compilation risk --------------------------------
+    "DX040": (SEV_WARNING, "ORDER BY over a computed string sorts on the host (device round-trip per batch)",
+              "sort on a device column, or accept the host-side finishing cost"),
+    "DX041": (SEV_ERROR, "string-op argument must be constant: dictionary tables are keyed on it",
+              "use a literal pattern/position (column-valued patterns have no device tier)"),
+    "DX042": (SEV_ERROR, "string function over a computed string (CONCAT/CAST result) is unsupported on device",
+              "apply the function to the inputs before concatenating"),
+}
+
+# which pass each code family belongs to (for grouping/reporting)
+PASS_NAMES = {
+    "DX00": "reference resolution",
+    "DX01": "type propagation",
+    "DX02": "aggregation/window legality",
+    "DX03": "dead flow",
+    "DX04": "device-compilation risk",
+}
+
+
+def make(code: str, table: str, message: str, span: Optional[Span] = None,
+         severity: Optional[str] = None) -> Diagnostic:
+    """Build a diagnostic, defaulting severity from the registry."""
+    default_sev = CODES[code][0]
+    return Diagnostic(
+        code=code,
+        severity=severity or default_sev,
+        table=table,
+        message=message,
+        span=span or Span(),
+    )
+
+
+@dataclass
+class AnalysisReport:
+    diagnostics: List[Diagnostic]
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.is_error]
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if not d.is_error]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def codes(self) -> List[str]:
+        return [d.code for d in self.diagnostics]
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "errorCount": len(self.errors),
+            "warningCount": len(self.warnings),
+            "diagnostics": [d.to_dict() for d in self.diagnostics],
+        }

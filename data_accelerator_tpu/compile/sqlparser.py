@@ -23,7 +23,13 @@ from typing import List, Optional, Tuple, Union
 
 
 class SqlParseError(Exception):
-    pass
+    """Parse failure; ``pos`` (when known) is the 0-based character
+    offset of the offending token in the statement text, so design-time
+    diagnostics can point at the exact source location."""
+
+    def __init__(self, message: str, pos: Optional[int] = None):
+        super().__init__(message)
+        self.pos = pos
 
 
 # ---------------------------------------------------------------------------
@@ -186,6 +192,7 @@ _CONTEXTUAL = ("HAVING", "ASC", "DESC", "RLIKE", "REGEXP")
 class Token:
     kind: str  # "num" | "str" | "ident" | "bq" | "op" | "kw" | "eof"
     value: str
+    pos: int = -1  # 0-based character offset in the source text
 
 
 def tokenize(text: str) -> List[Token]:
@@ -194,16 +201,20 @@ def tokenize(text: str) -> List[Token]:
     while pos < len(text):
         m = _TOKEN_RE.match(text, pos)
         if not m:
-            raise SqlParseError(f"unexpected character {text[pos]!r} at {pos}: ...{text[max(0,pos-20):pos+20]!r}")
+            raise SqlParseError(
+                f"unexpected character {text[pos]!r} at {pos}: ...{text[max(0,pos-20):pos+20]!r}",
+                pos=pos,
+            )
+        start = pos
         pos = m.end()
         if m.lastgroup == "ws":
             continue
         kind, value = m.lastgroup, m.group()
         if kind == "ident" and value.upper() in KEYWORDS:
-            tokens.append(Token("kw", value.upper()))
+            tokens.append(Token("kw", value.upper(), start))
         else:
-            tokens.append(Token(kind, value))
-    tokens.append(Token("eof", ""))
+            tokens.append(Token(kind, value, start))
+    tokens.append(Token("eof", "", len(text)))
     return tokens
 
 
@@ -235,7 +246,10 @@ class _Parser:
 
     def expect_kw(self, kw: str) -> None:
         if not self.accept_kw(kw):
-            raise SqlParseError(f"expected {kw}, got {self.peek().value!r} in: {self.text[:200]}")
+            raise SqlParseError(
+                f"expected {kw}, got {self.peek().value!r} in: {self.text[:200]}",
+                pos=self.peek().pos,
+            )
 
     def accept_op(self, op: str) -> bool:
         t = self.peek()
@@ -254,7 +268,10 @@ class _Parser:
 
     def expect_op(self, op: str) -> None:
         if not self.accept_op(op):
-            raise SqlParseError(f"expected {op!r}, got {self.peek().value!r} in: {self.text[:200]}")
+            raise SqlParseError(
+                f"expected {op!r}, got {self.peek().value!r} in: {self.text[:200]}",
+                pos=self.peek().pos,
+            )
 
     # -- grammar ---------------------------------------------------------
     def parse_select(self) -> Select:
@@ -323,7 +340,9 @@ class _Parser:
         if self.accept_kw("LIMIT"):
             t = self.next()
             if t.kind != "num" or "." in t.value:
-                raise SqlParseError(f"LIMIT expects an integer, got {t.value!r}")
+                raise SqlParseError(
+                    f"LIMIT expects an integer, got {t.value!r}", pos=t.pos
+                )
             limit = int(t.value)
 
         return Select(
@@ -343,7 +362,9 @@ class _Parser:
     def parse_table_ref(self) -> TableRef:
         t = self.next()
         if t.kind not in ("ident", "bq"):
-            raise SqlParseError(f"expected table name, got {t.value!r}")
+            raise SqlParseError(
+                f"expected table name, got {t.value!r}", pos=t.pos
+            )
         name = t.value.strip("`")
         alias = None
         if self.accept_kw("AS"):
@@ -447,7 +468,8 @@ class _Parser:
         if negated:
             raise SqlParseError(
                 "NOT must be followed by IN/LIKE/RLIKE/BETWEEN near "
-                f"{self.peek().value!r}"
+                f"{self.peek().value!r}",
+                pos=self.peek().pos,
             )
         return left
 
@@ -512,7 +534,9 @@ class _Parser:
             return inner
         if t.kind in ("ident", "bq"):
             return self.parse_identifier_or_call()
-        raise SqlParseError(f"unexpected token {t.value!r} in: {self.text[:200]}")
+        raise SqlParseError(
+            f"unexpected token {t.value!r} in: {self.text[:200]}", pos=t.pos
+        )
 
     def parse_case(self) -> Expr:
         self.expect_kw("CASE")
@@ -562,6 +586,7 @@ def parse_select(text: str) -> Select:
     sel = p.parse_select()
     if p.peek().kind != "eof":
         raise SqlParseError(
-            f"trailing tokens starting at {p.peek().value!r} in: {text[:200]}"
+            f"trailing tokens starting at {p.peek().value!r} in: {text[:200]}",
+            pos=p.peek().pos,
         )
     return sel

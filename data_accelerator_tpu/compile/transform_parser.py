@@ -32,6 +32,11 @@ class SqlCommand:
     text: str
     name: Optional[str]
     command_type: str
+    # 1-based source span of the statement body in the parsed script
+    # (0 = unknown, for callers constructing commands by hand); the
+    # analyzer points diagnostics here
+    line: int = 0
+    end_line: int = 0
 
 
 @dataclass(frozen=True)
@@ -49,11 +54,15 @@ class TransformParser:
         view_refs: Dict[str, int] = {}
         statement_buffer: List[str] = []
         table_name: Optional[str] = None
+        start_line = end_line = 0  # 1-based span of the current buffer
 
         def append_table(name: Optional[str]) -> None:
             sql = " ".join(s for s in statement_buffer if s)
             ctype = COMMAND_TYPE_COMMAND if name is None else COMMAND_TYPE_QUERY
-            commands.append(SqlCommand(sql, name, ctype))
+            commands.append(
+                SqlCommand(sql, name, ctype, line=start_line,
+                           end_line=end_line)
+            )
             if name:
                 if name in view_refs:
                     raise EngineException(
@@ -65,7 +74,7 @@ class TransformParser:
                     if re.search(rf"\b{re.escape(k)}\b", sql):
                         view_refs[k] += 1
 
-        for line in lines:
+        for lineno, line in enumerate(lines, start=1):
             if not line.strip():
                 continue
             if _SEPARATOR_RE.match(line):
@@ -77,6 +86,7 @@ class TransformParser:
                 continue
             else:
                 if not statement_buffer:
+                    start_line = lineno
                     m = _ASSIGN_RE.match(line)
                     if m:
                         table_name = m.group(1)
@@ -85,6 +95,7 @@ class TransformParser:
                         statement_buffer.append(line.strip())
                 else:
                     statement_buffer.append(line.strip())
+                end_line = lineno
 
         # flush the trailing section; unlike the reference (which only keeps
         # it when named, TransformSqlParser.scala:88-92) we also keep a

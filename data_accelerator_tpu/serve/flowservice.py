@@ -58,7 +58,35 @@ class FlowOperation:
     def get_all_flows(self) -> List[dict]:
         return self.design.get_all()
 
+    def validate_flow(self, flow: dict):
+        """Static analysis over a flow config (gui JSON or full doc) —
+        the same implementation the CLI runs, so the ``validate``
+        endpoint and ``python -m data_accelerator_tpu.analysis`` can
+        never drift. Returns an ``analysis.AnalysisReport``. (Imported
+        lazily: analysis reuses serve.flowbuilder for rule expansion.)"""
+        from ..analysis import analyze_flow
+
+        return analyze_flow(flow)
+
     def generate_configs(self, flow_name: str) -> GenerationResult:
+        doc = self.design.get_by_name(flow_name)
+        if doc is not None:
+            # deploy gate: a flow whose OUTPUT routes a dataset no
+            # transform produces would generate and start a job that
+            # produces nothing — fail here with the analyzer's
+            # unbound-reference diagnostic instead. Fail-open: an
+            # analyzer crash must not block generation (generation has
+            # its own validation stages).
+            try:
+                report = self.validate_flow(doc)
+            except Exception:  # noqa: BLE001
+                logger.exception("flow validation failed for %s", flow_name)
+            else:
+                unbound = [d for d in report.errors if d.code == "DX003"]
+                if unbound:
+                    return GenerationResult(
+                        flow_name, errors=[d.render() for d in unbound]
+                    )
         return self.generation.generate(flow_name)
 
     # -- runtime ---------------------------------------------------------

@@ -75,6 +75,7 @@ class DataXApi:
     def _register(self) -> None:
         r = self.routes
         r[("POST", "flow/save")] = (self._flow_save, True)
+        r[("POST", "flow/validate")] = (self._flow_validate, False)
         r[("POST", "flow/generateconfigs")] = (self._flow_generate, True)
         r[("POST", "flow/startjobs")] = (self._flow_start, True)
         r[("POST", "flow/stopjobs")] = (self._flow_stop, True)
@@ -160,6 +161,20 @@ class DataXApi:
         gui = body.get("gui") or body
         doc = self.flow_ops.save_flow(gui)
         return {"name": doc["name"], "displayName": doc.get("displayName")}
+
+    def _flow_validate(self, body, query):
+        """Static analysis; same diagnostics as the analysis CLI (shared
+        ``analysis.analyze_flow`` implementation). Body: a flow config
+        (gui JSON / full doc), or ``{"flowName": ...}`` for a saved one."""
+        flow = body.get("flow") or body.get("gui")
+        if flow is None and (body.get("flowName") or body.get("name")) \
+                and not body.get("process") and not body.get("input"):
+            flow = self.flow_ops.get_flow(self._flow_name(body, query))
+            if flow is None:
+                raise ApiError("flow not found", status=404)
+        if flow is None:
+            flow = body
+        return self.flow_ops.validate_flow(flow).to_dict()
 
     def _flow_generate(self, body, query):
         res = self.flow_ops.generate_configs(self._flow_name(body, query))

@@ -32,6 +32,34 @@ _SCHEMA = json.dumps({"type": "struct", "fields": [
 ]})
 
 
+def probe_deploy_gui(flow_name: str = "probe-deploy") -> dict:
+    """The SaveAndDeploy probe's flow config — module-level so the
+    analyzer self-lint (tests/test_analysis.py) can assert the shipped
+    probe stays diagnostics-clean."""
+    return {
+        "name": flow_name,
+        "displayName": "Probe Deploy",
+        "input": {"mode": "streaming", "type": "local", "properties": {
+            "inputSchemaFile": _SCHEMA,
+            "normalizationSnippet": "Raw.*",
+        }},
+        "process": {"queries": [
+            "--DataXQuery--\n"
+            "Hot = SELECT deviceId, temperature FROM DataXProcessedInput "
+            "WHERE temperature > 50;\n"
+            "OUTPUT Hot TO HotConsole;"
+        ]},
+        "outputs": [{"id": "HotConsole", "type": "console",
+                     "properties": {}}],
+    }
+
+
+def shipped_flow_guis() -> list:
+    """Every flow config this module ships — the analyzer self-lint
+    surface (all must produce zero error diagnostics)."""
+    return [probe_deploy_gui()]
+
+
 def _call(ctx: ScenarioContext, method: str, path: str, body=None):
     url = f"{ctx['base_url'].rstrip('/')}{path}"
     headers = {"Content-Type": "application/json"}
@@ -65,21 +93,8 @@ def save_and_deploy(
 
     @sc.step
     def save_flow(ctx):
-        gui = {
-            "name": flow_name,
-            "displayName": "Probe Deploy",
-            "input": {"mode": "streaming", "type": "local", "properties": {
-                "inputSchemaFile": _SCHEMA,
-                "normalizationSnippet": "Raw.*",
-            }},
-            "process": {"queries": [
-                "--DataXQuery--\n"
-                "Hot = SELECT deviceId, temperature FROM DataXProcessedInput "
-                "WHERE temperature > 50"
-            ]},
-            "outputs": [{"id": "Hot", "type": "console", "properties": {}}],
-        }
-        r = _call(ctx, "POST", "/api/flow/flow/save", gui)
+        r = _call(ctx, "POST", "/api/flow/flow/save",
+                  probe_deploy_gui(flow_name))
         assert r.get("name") == flow_name, r
 
     @sc.step

@@ -102,7 +102,9 @@ class SqlAnalyzer:
             try:
                 sel = parse_select(sql)
                 info.depends_on = self._source_tables(sel)
-                info.columns = self._project_columns(sel, known)
+                info.columns = self._project_columns(
+                    sel, known, res.errors, cmd.name
+                )
             except SqlParseError as e:
                 res.errors.append(f"{cmd.name}: {e}")
             except Exception as e:  # noqa: BLE001
@@ -125,18 +127,43 @@ class SqlAnalyzer:
         return out
 
     def _project_columns(
-        self, sel: Select, known: Dict[str, List[str]]
+        self,
+        sel: Select,
+        known: Dict[str, List[str]],
+        errors: Optional[List[str]] = None,
+        table_name: str = "",
     ) -> List[str]:
+        # FROM/JOIN scope in declaration order: binding (alias or name)
+        # -> upstream columns, so ``t.*`` expands only t's columns and a
+        # bare ``*`` is the union across every joined table
+        scope: List[tuple] = []
+        refs = ([sel.from_table] if sel.from_table is not None else [])
+        refs += [j.table for j in sel.joins]
+        for ref in refs:
+            scope.append((ref.binding, ref.name, known.get(ref.name)))
+
         cols: List[str] = []
+        explicit: set = set()
         for item in sel.items:
             if isinstance(item.expr, Star):
-                # expand from the (first) source table when known
-                for src in self._source_tables(sel):
-                    for c in known.get(src, []):
+                for binding, name, upstream in scope:
+                    if item.expr.table is not None and item.expr.table not in (
+                        binding, name
+                    ):
+                        continue
+                    for c in upstream or []:
                         if c not in cols:
                             cols.append(c)
                 continue
             name = item.alias or _expr_name(item.expr)
+            # "expr" is the display placeholder for unnamed expressions,
+            # not a real output name — colliding there is not an error
+            if name in explicit and name != "expr" and errors is not None:
+                errors.append(
+                    f"{table_name}: duplicate output column '{name}' — "
+                    "alias one of the colliding select items"
+                )
+            explicit.add(name)
             if name not in cols:
                 cols.append(name)
         return cols

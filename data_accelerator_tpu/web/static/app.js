@@ -129,11 +129,34 @@ route("#/flow/", async (view, hash) => {
     await api("POST", "/api/flow/flow/save", gui);
     toast("flow saved");
   };
+  // inline diagnostics from the flow static analyzer (flow/validate —
+  // same DXnnn diagnostics as `python -m data_accelerator_tpu.analysis`)
+  const diagBox = h("div", { class: "diags" });
+  const renderDiags = (r) => {
+    diagBox.replaceChildren(
+      h("div", { class: "muted" },
+        r.ok ? `analyzer: clean (${r.warningCount} warning(s))`
+             : `analyzer: ${r.errorCount} error(s), ${r.warningCount} warning(s)`),
+      ...r.diagnostics.map((d) => h("div", { class: `diag sev-${d.severity}` },
+        h("span", { class: "diag-code" }, d.code),
+        d.table ? h("span", { class: "diag-table" }, d.table) : null,
+        h("span", {}, d.message),
+        d.span && d.span.line ? h("span", { class: "muted" }, ` line ${d.span.line}`) : null)));
+  };
+  const validate = async () => {
+    await save();
+    const r = await api("POST", "/api/flow/flow/validate", { flow: gui });
+    renderDiags(r);
+    toast(r.ok ? "flow is clean" : `${r.errorCount} error(s) found`, r.ok);
+    return r;
+  };
   const actions = h("div", { class: "row" },
     h("button", { onclick: save }, "Save"),
+    h("button", { class: "ghost", onclick: validate }, "Validate"),
     h("button", {
       class: "ghost", onclick: async () => {
-        await save();
+        const r0 = await validate();
+        if (!r0.ok) { toast("fix analyzer errors before generating", false); return; }
         const r = await api("POST", "/api/flow/flow/generateconfigs", { flowName: name });
         toast(`generated: ${(r.jobNames || []).join(", ")}`);
       },
@@ -150,7 +173,7 @@ route("#/flow/", async (view, hash) => {
         toast(`stopped ${r.length} job(s)`);
       },
     }, "Stop"));
-  view.append(actions);
+  view.append(actions, diagBox);
 
   const field = (obj, key, label, opts) => {
     const input = opts && opts.options

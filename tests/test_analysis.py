@@ -1,0 +1,391 @@
+"""Flow static analyzer tests.
+
+- golden fixtures: one flow per DXnnn diagnostic code under
+  tests/data/flows/, asserting code, severity and span
+- no-false-positives: the clean_* fixtures mirror BASELINE configs 2-5
+  and the multisource windowed-join flow (tests/test_multisource.py)
+  and must produce zero diagnostics
+- self-lint (tier-1 CI): every shipped scenario/baseline flow config
+  must produce zero error diagnostics
+- CLI contract: non-zero exit + DX-coded output for each of the five
+  pass categories; zero exit on every clean config; --json mode
+- endpoint parity: flow/validate returns the same diagnostics as the
+  CLI for the same flow JSON (single shared implementation)
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from data_accelerator_tpu.analysis import (
+    CODES,
+    SEV_ERROR,
+    SEV_WARNING,
+    analyze_flow,
+)
+from data_accelerator_tpu.serve.scenarios import shipped_flow_guis
+
+FLOWS_DIR = os.path.join(os.path.dirname(__file__), "data", "flows")
+
+
+def load_flow(name: str) -> dict:
+    with open(os.path.join(FLOWS_DIR, name + ".json")) as f:
+        return json.load(f)
+
+
+def clean_flow_paths():
+    return sorted(
+        os.path.join(FLOWS_DIR, f)
+        for f in os.listdir(FLOWS_DIR)
+        if f.startswith("clean_") and f.endswith(".json")
+    )
+
+
+# ---------------------------------------------------------------------------
+# golden fixtures: (fixture, code, severity, span line of that code)
+# ---------------------------------------------------------------------------
+GOLDEN = [
+    ("dx001_unbound_table", "DX001", SEV_ERROR, 2),
+    ("dx002_unbound_column", "DX002", SEV_ERROR, 2),
+    ("dx003_output_unproduced", "DX003", SEV_ERROR, 0),
+    ("dx004_undeclared_sink", "DX004", SEV_ERROR, 0),
+    ("dx005_forward_reference", "DX005", SEV_ERROR, 2),
+    ("dx006_unknown_function", "DX006", SEV_ERROR, 2),
+    ("dx007_duplicate_alias", "DX007", SEV_ERROR, 2),
+    ("dx008_parse_error", "DX008", SEV_ERROR, 2),
+    ("dx009_bad_window_target", "DX009", SEV_ERROR, 0),
+    ("dx010_type_mismatch", "DX010", SEV_ERROR, 2),
+    ("dx011_join_key_types", "DX011", SEV_ERROR, 2),
+    ("dx012_bad_cast_literal", "DX012", SEV_ERROR, 2),
+    ("dx020_aggregate_in_where", "DX020", SEV_ERROR, 2),
+    ("dx021_window_budget", "DX021", SEV_WARNING, 0),
+    ("dx022_accumulator_misuse", "DX022", SEV_ERROR, 2),
+    ("dx030_dead_view", "DX030", SEV_WARNING, 2),
+    ("dx031_no_outputs", "DX031", SEV_WARNING, 0),
+    ("dx040_host_order_by", "DX040", SEV_WARNING, 2),
+    ("dx041_nonconstant_pattern", "DX041", SEV_ERROR, 2),
+    ("dx042_fn_over_computed_string", "DX042", SEV_ERROR, 2),
+]
+
+
+@pytest.mark.parametrize("fixture,code,severity,line", GOLDEN,
+                         ids=[g[0] for g in GOLDEN])
+def test_golden_diagnostic(fixture, code, severity, line):
+    report = analyze_flow(load_flow(fixture))
+    hits = [d for d in report.diagnostics if d.code == code]
+    assert hits, f"expected {code}, got {report.codes()}"
+    d = hits[0]
+    assert d.severity == severity
+    assert d.span.line == line
+    assert d.severity == CODES[code][0]  # registry is the source of truth
+
+
+def test_every_registered_code_has_a_golden_fixture():
+    assert {g[1] for g in GOLDEN} == set(CODES)
+
+
+def test_analysis_md_documents_every_code():
+    """ANALYSIS.md is generated from the registry's cause/fix strings —
+    every code (and its fix line) must appear there."""
+    doc_path = os.path.join(os.path.dirname(FLOWS_DIR), "..", "..",
+                            "ANALYSIS.md")
+    with open(os.path.normpath(doc_path)) as f:
+        doc = f.read()
+    for code, (_sev, _cause, fix) in CODES.items():
+        assert code in doc, f"{code} missing from ANALYSIS.md"
+        assert fix in doc, f"{code} fix line missing from ANALYSIS.md"
+
+
+def test_error_fixture_reports_are_not_ok():
+    for fixture, code, severity, _ in GOLDEN:
+        report = analyze_flow(load_flow(fixture))
+        assert report.ok == (severity != SEV_ERROR), fixture
+
+
+# ---------------------------------------------------------------------------
+# no false positives / self-lint
+# ---------------------------------------------------------------------------
+def test_clean_fixtures_have_zero_diagnostics():
+    paths = clean_flow_paths()
+    assert len(paths) >= 5  # baseline 2-5 mirrors + multisource join
+    for path in paths:
+        with open(path) as f:
+            report = analyze_flow(json.load(f))
+        assert report.diagnostics == [], (
+            f"{os.path.basename(path)}: {[d.render() for d in report.diagnostics]}"
+        )
+
+
+def test_multisource_windowed_join_no_false_positives():
+    """The full cross-stream sliding-window-join shape from
+    tests/test_multisource.py, as a flow config: two sources, per-source
+    schemas, a TIMEWINDOW over the second stream's target table."""
+    report = analyze_flow(load_flow("clean_multisource_window_join"))
+    assert report.diagnostics == []
+
+
+def test_self_lint_shipped_scenario_flows():
+    """Tier-1 CI gate: every flow config the repo ships stays clean —
+    the platform must pass its own analyzer."""
+    guis = shipped_flow_guis()
+    assert guis
+    for gui in guis:
+        report = analyze_flow(gui)
+        assert report.errors == [], (
+            f"{gui.get('name')}: {[d.render() for d in report.errors]}"
+        )
+
+
+def test_self_lint_generation_sample_flow():
+    """The HomeAutomation-style designer sample used across the serve
+    tests (rules + queries) must analyze without errors."""
+    from test_serve_generation import make_gui
+
+    report = analyze_flow(make_gui("SelfLint"))
+    assert report.errors == [], [d.render() for d in report.errors]
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+def _run_cli(args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.setdefault("PYTHONPATH", os.path.dirname(os.path.dirname(__file__)))
+    return subprocess.run(
+        [sys.executable, "-m", "data_accelerator_tpu.analysis", *args],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(__file__)),
+    )
+
+
+# one error fixture per pass category (the CLI acceptance contract)
+CATEGORY_FIXTURES = {
+    "DX001": "dx001_unbound_table",         # 1 reference resolution
+    "DX010": "dx010_type_mismatch",         # 2 type propagation
+    "DX020": "dx020_aggregate_in_where",    # 3 aggregation/window legality
+    "DX030": "dx003_output_unproduced",     # 4 dead flow family gate (DX003)
+    "DX041": "dx041_nonconstant_pattern",   # 5 device-compilation risk
+}
+
+
+def test_cli_nonzero_exit_per_pass_category():
+    paths = [os.path.join(FLOWS_DIR, f + ".json")
+             for f in CATEGORY_FIXTURES.values()]
+    proc = _run_cli(paths)
+    assert proc.returncode == 1, proc.stderr
+    for code in ("DX001", "DX010", "DX020", "DX003", "DX041"):
+        assert code in proc.stdout, (code, proc.stdout)
+
+
+def test_cli_zero_exit_on_clean_configs(tmp_path):
+    # every clean baseline-mirror fixture AND every shipped scenario
+    # flow config must exit zero through the real CLI
+    paths = clean_flow_paths()
+    for i, gui in enumerate(shipped_flow_guis()):
+        p = tmp_path / f"scenario{i}.json"
+        p.write_text(json.dumps(gui))
+        paths.append(str(p))
+    proc = _run_cli(paths)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "0 error(s)" in proc.stdout
+
+
+def test_cli_json_mode_matches_validate_endpoint():
+    """Acceptance: flow/validate returns the same diagnostics as the
+    CLI for the same flow JSON."""
+    from test_serve_jobs import FakeJobClient
+
+    from data_accelerator_tpu.serve.flowservice import FlowOperation
+    from data_accelerator_tpu.serve.restapi import DataXApi
+    from data_accelerator_tpu.serve.storage import (
+        LocalDesignTimeStorage,
+        LocalRuntimeStorage,
+    )
+
+    path = os.path.join(FLOWS_DIR, "dx002_unbound_column.json")
+    proc = _run_cli(["--json", path])
+    assert proc.returncode == 1
+    cli_report = json.loads(proc.stdout)
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        api = DataXApi(FlowOperation(
+            LocalDesignTimeStorage(os.path.join(td, "design")),
+            LocalRuntimeStorage(os.path.join(td, "runtime")),
+            job_client=FakeJobClient(),
+        ))
+        status, out = api.dispatch(
+            "POST", "api/flow/validate", body={"flow": load_flow("dx002_unbound_column")}
+        )
+    assert status == 200
+    assert out["result"]["diagnostics"] == cli_report["diagnostics"]
+    assert out["result"]["errorCount"] == cli_report["errorCount"]
+
+
+def test_cli_usage_error_without_args():
+    proc = _run_cli([])
+    assert proc.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# validate endpoint + deploy gate (flowservice)
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def flow_ops(tmp_path):
+    from test_serve_jobs import FakeJobClient
+
+    from data_accelerator_tpu.serve.flowservice import FlowOperation
+    from data_accelerator_tpu.serve.storage import (
+        LocalDesignTimeStorage,
+        LocalRuntimeStorage,
+    )
+
+    return FlowOperation(
+        LocalDesignTimeStorage(str(tmp_path / "design")),
+        LocalRuntimeStorage(str(tmp_path / "runtime")),
+        job_client=FakeJobClient(),
+    )
+
+
+def test_validate_endpoint_saved_flow(flow_ops):
+    from data_accelerator_tpu.serve.restapi import DataXApi
+
+    api = DataXApi(flow_ops)
+    gui = load_flow("dx001_unbound_table")
+    api.dispatch("POST", "api/flow/save", body=gui)
+    status, out = api.dispatch(
+        "POST", "api/flow/validate", body={"flowName": gui["name"]}
+    )
+    assert status == 200
+    assert out["result"]["ok"] is False
+    assert out["result"]["diagnostics"][0]["code"] == "DX001"
+    assert out["result"]["diagnostics"][0]["span"]["line"] == 2
+
+
+def test_generate_configs_rejects_output_of_unproduced_dataset(flow_ops):
+    """Satellite bugfix: a flow whose OUTPUT names a dataset no
+    transform produces used to deploy a job that produced nothing; now
+    generation fails with the analyzer's DX003 diagnostic."""
+    gui = load_flow("dx003_output_unproduced")
+    flow_ops.save_flow(gui)
+    res = flow_ops.generate_configs(gui["name"])
+    assert not res.ok
+    assert any("DX003" in e for e in res.errors)
+    assert res.job_names == []  # nothing deployed
+
+    # the clean sibling flow generates fine through the same gate
+    clean = load_flow("clean_config5_fanout_groupby")
+    flow_ops.save_flow(clean)
+    res = flow_ops.generate_configs(clean["name"])
+    assert res.ok, res.errors
+
+
+def test_warnings_do_not_block_generation(flow_ops):
+    gui = load_flow("dx030_dead_view")
+    flow_ops.save_flow(gui)
+    res = flow_ops.generate_configs(gui["name"])
+    assert res.ok, res.errors
+
+
+# ---------------------------------------------------------------------------
+# satellite: sqlanalyzer star projection + duplicate aliases
+# ---------------------------------------------------------------------------
+class TestSqlAnalyzerSatellites:
+    def test_star_unions_multi_table_join_scope(self):
+        from data_accelerator_tpu.serve.sqlanalyzer import SqlAnalyzer
+
+        script = (
+            "--DataXQuery--\n"
+            "L = SELECT deviceId, temperature FROM DataXProcessedInput;\n"
+            "--DataXQuery--\n"
+            "R = SELECT deviceId, windSpeed FROM DataXProcessedInput;\n"
+            "--DataXQuery--\n"
+            "J = SELECT * FROM L INNER JOIN R ON L.deviceId = R.deviceId;\n"
+        )
+        res = SqlAnalyzer().analyze(
+            script, input_columns=["deviceId", "temperature", "windSpeed"]
+        )
+        assert not res.errors
+        # union of BOTH join sides, not just the first table
+        assert res.table("J").columns == ["deviceId", "temperature", "windSpeed"]
+
+    def test_qualified_star_expands_only_that_table(self):
+        from data_accelerator_tpu.serve.sqlanalyzer import SqlAnalyzer
+
+        script = (
+            "--DataXQuery--\n"
+            "L = SELECT deviceId, temperature FROM DataXProcessedInput;\n"
+            "--DataXQuery--\n"
+            "R = SELECT stationId, windSpeed FROM DataXProcessedInput;\n"
+            "--DataXQuery--\n"
+            "J = SELECT b.*, a.temperature FROM L a INNER JOIN R b "
+            "ON a.deviceId = b.stationId;\n"
+        )
+        res = SqlAnalyzer().analyze(
+            script,
+            input_columns=["deviceId", "temperature", "stationId", "windSpeed"],
+        )
+        assert not res.errors
+        assert res.table("J").columns == ["stationId", "windSpeed", "temperature"]
+
+    def test_duplicate_output_alias_is_an_error(self):
+        from data_accelerator_tpu.serve.sqlanalyzer import SqlAnalyzer
+
+        script = (
+            "--DataXQuery--\n"
+            "T = SELECT deviceId AS x, temperature AS x "
+            "FROM DataXProcessedInput;\n"
+        )
+        res = SqlAnalyzer().analyze(
+            script, input_columns=["deviceId", "temperature"]
+        )
+        assert any("duplicate output column 'x'" in e for e in res.errors)
+
+
+# ---------------------------------------------------------------------------
+# satellite: spans on parsed commands + parse errors
+# ---------------------------------------------------------------------------
+class TestSpans:
+    def test_transform_commands_carry_line_spans(self):
+        from data_accelerator_tpu.compile.transform_parser import TransformParser
+
+        script = (
+            "--DataXQuery--\n"            # line 1
+            "A = SELECT 1 AS x\n"         # line 2
+            "FROM DataXProcessedInput\n"  # line 3
+            "\n"
+            "--DataXQuery--\n"            # line 5
+            "B = SELECT 2 AS y FROM A\n"  # line 6
+        )
+        result = TransformParser.parse_text(script)
+        a, b = result.commands
+        assert (a.line, a.end_line) == (2, 3)
+        assert (b.line, b.end_line) == (6, 6)
+
+    def test_sqlparse_error_carries_offset(self):
+        from data_accelerator_tpu.compile.sqlparser import (
+            SqlParseError,
+            parse_select,
+        )
+
+        sql = "SELECT a FROM t WHERE ~"
+        with pytest.raises(SqlParseError) as ei:
+            parse_select(sql)
+        assert ei.value.pos == sql.index("~")
+
+        sql2 = "SELECT a FROM t GROUP 4"
+        with pytest.raises(SqlParseError) as ei:
+            parse_select(sql2)
+        assert ei.value.pos == sql2.index("4")
+
+    def test_parse_error_diagnostic_points_at_offset(self):
+        report = analyze_flow(load_flow("dx008_parse_error"))
+        d = next(d for d in report.diagnostics if d.code == "DX008")
+        # "T = SELECT FROM WHERE" -> joined statement "SELECT FROM WHERE",
+        # error at the FROM token (offset 7 -> col 8)
+        assert d.span.line == 2
+        assert d.span.col == 8
