@@ -53,6 +53,13 @@ PER_CHIP_TARGET = 1_000_000 / 16.0  # north-star share per chip
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# the per-stage decomposition routes through the SAME histogram type the
+# streaming host feeds live (obs/histogram.py): one observe()/percentile()
+# code path, so BENCH_*.json and the /metrics surface cannot drift. The
+# window (2048) covers every sample this harness records, so percentiles
+# here are exact (identical to np.percentile over the raw lists).
+BENCH_FLOW = "bench"
+
 
 def build_processor(capacity):
     from __graft_entry__ import _build
@@ -101,13 +108,14 @@ def bench_decoder(proc, payload, n_rows, iters=8):
     return n_rows / t, len(payload) / t / 1e6
 
 
-def pipelined_ingest_loop(proc, payloads, iters, base_ms):
+def pipelined_ingest_loop(proc, payloads, iters, base_ms, hist):
     """The production throughput shape (StreamingHost.run_pipelined):
     a decode-ahead worker thread parses batch N+1's JSON (the C++
     decoder releases the GIL) while the main thread dispatches batch N
     and collects N-1 — so host decode overlaps device compute AND
-    result transport. Returns events/s and per-batch t0->collected ms
-    (t0 BEFORE the decode, so ingest-inclusive)."""
+    result transport. Returns events/s; per-batch t0->collected ms (t0
+    BEFORE the decode, so ingest-inclusive) lands in ``hist`` under the
+    streaming host's whole-batch stage name."""
     from concurrent.futures import ThreadPoolExecutor
 
     def decode(i):
@@ -118,7 +126,6 @@ def pipelined_ingest_loop(proc, payloads, iters, base_ms):
         )
         return raw, t0
 
-    lat_collect = []
     pending = None  # (handle, t0)
     pool = ThreadPoolExecutor(1)
     try:
@@ -135,23 +142,26 @@ def pipelined_ingest_loop(proc, payloads, iters, base_ms):
             if pending is not None:
                 ph, pt0 = pending
                 ph.collect()
-                lat_collect.append((time.perf_counter() - pt0) * 1000.0)
+                hist.observe(
+                    BENCH_FLOW, "batch", (time.perf_counter() - pt0) * 1000.0
+                )
             pending = (handle, t0)
         ph, pt0 = pending
         ph.collect()
-        lat_collect.append((time.perf_counter() - pt0) * 1000.0)
+        hist.observe(BENCH_FLOW, "batch", (time.perf_counter() - pt0) * 1000.0)
         total_s = time.perf_counter() - t_start
     finally:
         pool.shutdown(wait=False, cancel_futures=True)
     events = proc.batch_capacity * iters
-    return events / total_s, lat_collect
+    return events / total_s
 
 
-def sequential_latency_loop(proc, payloads, iters, base_ms):
+def sequential_latency_loop(proc, payloads, iters, base_ms, hist):
     """True per-batch latency: decode -> dispatch -> completion sync ->
-    collect, one batch at a time. Returns per-stage ms lists."""
-    stages = {k: [] for k in ("decode", "dispatch", "sync", "collect",
-                              "compute", "eval")}
+    collect, one batch at a time. Observes each stage into ``hist``
+    under the SAME stage names the streaming host uses, plus the bench
+    rollups (compute = decode..sync, eval = decode..collect,
+    engine-host = decode+dispatch)."""
     for i in range(iters):
         t0 = time.perf_counter()
         raw = proc.encode_json_bytes(
@@ -164,13 +174,13 @@ def sequential_latency_loop(proc, payloads, iters, base_ms):
         t3 = time.perf_counter()
         h.collect()
         t4 = time.perf_counter()
-        stages["decode"].append((t1 - t0) * 1e3)
-        stages["dispatch"].append((t2 - t1) * 1e3)
-        stages["sync"].append((t3 - t2) * 1e3)
-        stages["collect"].append((t4 - t3) * 1e3)
-        stages["compute"].append((t3 - t0) * 1e3)
-        stages["eval"].append((t4 - t0) * 1e3)
-    return stages
+        hist.observe(BENCH_FLOW, "decode", (t1 - t0) * 1e3)
+        hist.observe(BENCH_FLOW, "dispatch", (t2 - t1) * 1e3)
+        hist.observe(BENCH_FLOW, "sync", (t3 - t2) * 1e3)
+        hist.observe(BENCH_FLOW, "collect", (t4 - t3) * 1e3)
+        hist.observe(BENCH_FLOW, "compute", (t3 - t0) * 1e3)
+        hist.observe(BENCH_FLOW, "eval", (t4 - t0) * 1e3)
+        hist.observe(BENCH_FLOW, "engine-host", (t2 - t0) * 1e3)
 
 
 def measure_sync_rtt(proc, payload, base_ms, iters=8):
@@ -228,6 +238,10 @@ def main():
     runs = int(os.environ.get("BENCH_RUNS", "3"))
     base_ms = 1_700_000_000_000
 
+    from data_accelerator_tpu.obs.histogram import HistogramRegistry
+
+    hist = HistogramRegistry()
+
     # -- throughput: ingest-inclusive pipelined loop, multi-run ----------
     proc = build_processor(capacity)
     payloads = [
@@ -237,15 +251,13 @@ def main():
     for i in range(warmup):
         raw = proc.encode_json_bytes(payloads[0], base_ms - 60_000 + i * 1000)
         proc.process_batch(raw, batch_time_ms=base_ms - 60_000 + i * 1000)
-    run_eps, lat_collect = [], []
+    run_eps = []
     for r in range(runs):
-        eps_r, lat_r = pipelined_ingest_loop(
-            proc, payloads, iters, base_ms + r * 120_000
-        )
-        run_eps.append(eps_r)
-        lat_collect.extend(lat_r)
+        run_eps.append(pipelined_ingest_loop(
+            proc, payloads, iters, base_ms + r * 120_000, hist
+        ))
     eps = float(np.median(run_eps))
-    p99_batch = float(np.percentile(lat_collect, 99))
+    p99_batch = hist.percentile(BENCH_FLOW, "batch", 99)
 
     # -- latency mode: small batches, sequential, with stage breakdown ---
     lat_cap = int(os.environ.get("BENCH_LATENCY_CAPACITY", "8192"))
@@ -258,33 +270,28 @@ def main():
             lpayloads[0], base_ms + 900_000 + i * 1000
         )
         lproc.process_batch(lraw, batch_time_ms=base_ms + 900_000 + i * 1000)
-    all_stages = None
     for r in range(runs):
-        s = sequential_latency_loop(
-            lproc, lpayloads, 24, base_ms + 910_000 + r * 120_000
+        sequential_latency_loop(
+            lproc, lpayloads, 24, base_ms + 910_000 + r * 120_000, hist
         )
-        if all_stages is None:
-            all_stages = s
-        else:
-            for k in all_stages:
-                all_stages[k].extend(s[k])
     sync_rtt = measure_sync_rtt(lproc, lpayloads[0], base_ms + 990_000)
     device_step = measure_device_step(
         lproc, lpayloads, base_ms + 1_200_000, sync_rtt
     )
 
-    med = {k: float(np.median(v)) for k, v in all_stages.items()}
-    p99_rule = float(np.percentile(all_stages["eval"], 99))
-    p99_compute = float(np.percentile(all_stages["compute"], 99))
-    # engine latency = host ingest work (per-sample decode+dispatch, so
-    # its real tail shows) + amortized device compute. The completion
-    # sync is EXCLUDED here — not hidden: it is reported as
-    # tunnel_sync_rtt_ms and shown to be the idle-device round trip,
-    # i.e. topology, not engine work. rule_eval ~= engine + sync.
-    host_part = [
-        d + p for d, p in zip(all_stages["decode"], all_stages["dispatch"])
-    ]
-    p99_engine = float(np.percentile(host_part, 99)) + device_step
+    med = {
+        k: hist.percentile(BENCH_FLOW, k, 50)
+        for k in ("decode", "dispatch", "sync", "collect")
+    }
+    p99_rule = hist.percentile(BENCH_FLOW, "eval", 99)
+    p99_compute = hist.percentile(BENCH_FLOW, "compute", 99)
+    # engine latency = host ingest work (per-sample decode+dispatch as
+    # the "engine-host" stage, so its real tail shows) + amortized
+    # device compute. The completion sync is EXCLUDED here — not
+    # hidden: it is reported as tunnel_sync_rtt_ms and shown to be the
+    # idle-device round trip, i.e. topology, not engine work.
+    # rule_eval ~= engine + sync.
+    p99_engine = hist.percentile(BENCH_FLOW, "engine-host", 99) + device_step
 
     print(json.dumps({
         "metric": "iot_alerting_events_per_sec_per_chip_ingest_inclusive",

@@ -29,6 +29,7 @@ import re
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
+import jax
 import jax.numpy as jnp
 
 from ..core.config import EngineException
@@ -125,6 +126,45 @@ Value = Union[CompiledExpr, StructValue, ArrayValue, HostStr]
 
 def is_device(v: Value) -> bool:
     return isinstance(v, CompiledExpr)
+
+
+def _int_str_hash(n: jnp.ndarray, p: int):
+    """Rolling hash of ``str(n)`` computed ON DEVICE for int32 ``n`` —
+    the tier that makes ``CONCAT(..., CAST(n AS STRING))`` first-class
+    (stringified numerics have unbounded value space, so no dictionary
+    table can cover them; their decimal rendering is integer math).
+
+    Returns ``(H_p(str(n)), p^len(str(n)))`` as int32 bit patterns,
+    matching ``stringops.poly_hash``/``pow_len`` of the host rendering
+    exactly (uint32 arithmetic == int32 wrap-around bit-for-bit). The
+    magnitude runs in uint32 so INT32_MIN's absolute value survives."""
+    from .stringops import _MASK32
+
+    u = jax.lax.bitcast_convert_type(
+        jnp.asarray(n, jnp.int32), jnp.uint32
+    )
+    neg = n < 0
+    a = jnp.where(neg, jnp.uint32(0) - u, u)
+    ndigits = jnp.ones(a.shape, jnp.int32)
+    for k in range(1, 10):
+        ndigits = ndigits + (a >= jnp.uint32(10 ** k)).astype(jnp.int32)
+    # chars are '-' then most-significant digit first: walk fixed 10
+    # digit slots, folding only the active ones (XLA unrolls; no loop)
+    h = jnp.where(neg, jnp.uint32(ord("-") + 1), jnp.uint32(0))
+    pu = jnp.uint32(p & _MASK32)
+    for i in range(9, -1, -1):
+        digit = (a // jnp.uint32(10 ** i)) % jnp.uint32(10)
+        folded = h * pu + (jnp.uint32(ord("0") + 1) + digit)
+        h = jnp.where(ndigits > i, folded, h)
+    # p^len (len includes the sign char) via a 12-entry constant table
+    pow_tbl = jnp.asarray(
+        [pow(p, k, 1 << 32) for k in range(12)], jnp.uint32
+    )
+    plen = pow_tbl[ndigits + neg.astype(jnp.int32)]
+    return (
+        jax.lax.bitcast_convert_type(h, jnp.int32),
+        jax.lax.bitcast_convert_type(plen, jnp.int32),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -379,9 +419,14 @@ class ExprCompiler:
         independent rolling hashes compose over concatenation via the
         per-id hash/p^len tables (see stringops.register_strhash), so a
         computed string never needs a dictionary id to participate in
-        device comparisons. Returns None when ``v`` is not a string or
-        contains non-string device parts (CAST(<numeric> AS STRING) has
-        unbounded value space — no table can cover it).
+        device comparisons. ``CAST(<long> AS STRING)`` parts have
+        unbounded value space — no table can cover them — but their
+        decimal rendering is pure integer math, so the device computes
+        the rolling hash of the digit string directly (see
+        ``_int_str_hash``). Returns None when ``v`` is not a string or
+        contains parts with no device tier (CAST of double — float
+        formatting is not device math; CONCAT_WS — skip-null breaks the
+        rolling-hash composition).
 
         reference parity: the reference composes string expressions
         freely because Spark SQL evaluates them row-by-row
@@ -412,7 +457,11 @@ class ExprCompiler:
             for p in v.parts:
                 if isinstance(p, str):
                     parts.append(p)
-                elif is_device(p) and p.type == "string":
+                elif is_device(p) and p.type in ("string", "long"):
+                    # long: CAST(n AS STRING) — digit hash computed on
+                    # device (_int_str_hash); other types have no exact
+                    # device rendering (double formatting, timestamp
+                    # patterns) and fall back to host-only
                     parts.append(p)
                 else:
                     return None
@@ -429,7 +478,9 @@ class ExprCompiler:
         def null_of(env, parts=parts):
             n = jnp.broadcast_to(jnp.asarray(False), env.shape)
             for p in parts:
-                if not isinstance(p, str):
+                # only STRING parts can be null (id 0); a long part's 0
+                # is the number zero, which stringifies to "0"
+                if not isinstance(p, str) and p.type == "string":
                     n = n | (p.fn(env) == 0)
             return n
 
@@ -440,7 +491,8 @@ class ExprCompiler:
                 for p in parts
             ]
 
-            def run(env, parts=parts, consts=consts, hkey=hkey, pkey=pkey):
+            def run(env, parts=parts, consts=consts, hkey=hkey, pkey=pkey,
+                    hp=hp):
                 th = env.scopes["__aux"][hkey]
                 tq = env.scopes["__aux"][pkey]
                 h_acc = jnp.zeros(env.shape, jnp.int32)
@@ -449,9 +501,14 @@ class ExprCompiler:
                         # H(a+lit) = H(a)*p^len(lit) + H(lit), int32 wrap
                         h_acc = h_acc * jnp.asarray(c[1], jnp.int32) \
                             + jnp.asarray(c[0], jnp.int32)
-                    else:
+                    elif p.type == "string":
                         idx = jnp.clip(p.fn(env), 0, th.shape[0] - 1)
                         h_acc = h_acc * tq[idx] + th[idx]
+                    else:
+                        # stringified integer: hash of the decimal
+                        # rendering, computed in uint32 device math
+                        ph, pl = _int_str_hash(p.fn(env), hp)
+                        h_acc = h_acc * pl + ph
                 # a NULL part nulls the whole string; zero the hash so
                 # every null row carries the same key (SQL groups NULLs
                 # together)
@@ -471,8 +528,9 @@ class ExprCompiler:
         if lk is None or rk is None:
             raise EngineException(
                 "string comparison with a computed string requires both "
-                "sides to be strings built from string columns/literals; "
-                f"CAST of numeric values to string cannot compare on device: {e!r}"
+                "sides to be strings built from string columns/literals "
+                "or stringified integers; CAST of double/timestamp values "
+                f"to string cannot compare on device: {e!r}"
             )
         h1l, h2l, nl = lk
         h1r, h2r, nr = rk

@@ -1112,10 +1112,12 @@ class SelectCompiler:
             v = plain.compile(g)
             if isinstance(v, HostStr):
                 # computed string key: group by its device hash triple
-                # (exact string-equality classes); when the deferred
-                # expression embeds non-string parts (CAST of numerics),
-                # fall back to grouping by the part tuple — a refinement
-                # of string equality (may split "a"+"bc" from "ab"+"c")
+                # (exact string-equality classes; stringified integers
+                # hash their decimal rendering on device); when the
+                # deferred expression embeds parts with no device tier
+                # (CAST of doubles), fall back to grouping by the part
+                # tuple — a refinement of string equality (may split
+                # "a"+"bc" from "ab"+"c")
                 hk = plain.hash_keys(v)
                 if hk is not None:
                     key_compiled.extend(hk)

@@ -63,9 +63,62 @@ class JobArgument:
 
 
 class MetricName:
-    """reference: MetricName.scala:8"""
+    """reference: MetricName.scala:8 — extended with the registry of
+    every metric name the ENGINE itself emits (user flows may add
+    arbitrary names via ``OUTPUT ... TO Metrics``; those are data, not
+    registry members).
+
+    The registry is the contract between the runtime, the Prometheus
+    exposition, the SPA dashboard and OBSERVABILITY.md — a tier-1 test
+    asserts emitted names match it, so a renamed metric cannot silently
+    orphan a dashboard tile (the ANALYSIS.md-registry sync pattern).
+    """
 
     MetricSinkPrefix = "Sink_"
+    LatencyPrefix = "Latency-"
+
+    # canonical per-batch stage names (span names == histogram stages ==
+    # the <stage> of Latency-<stage> metrics, modulo capitalization)
+    STAGES = (
+        "decode", "dispatch", "device-step", "sync", "collect",
+        "sinks", "checkpoint", "batch",
+    )
+
+    # regexes over the metric part of ``DATAX-<flow>:<metric>`` covering
+    # everything the engine emits at runtime (host + processor + sinks +
+    # histogram percentile series). Anchored full-match.
+    RUNTIME_METRIC_PATTERNS = (
+        # raw per-batch latencies (back-compat dashboard series)
+        r"Latency-(Batch|Process)",
+        # per-stage histogram percentiles (obs/histogram.py)
+        r"Latency-(Decode|Dispatch|DeviceStep|Sync|Collect|Sinks|"
+        r"Checkpoint|Batch)-p(50|95|99)",
+        r"BatchProcessedET",
+        r"IngestRateScale",
+        r"Input_[A-Za-z0-9_.]+_Events_Count",
+        r"Input_[A-Za-z0-9_.]+_Count",
+        r"Output_[A-Za-z0-9_.]+_Events_Count",
+        r"Output_[A-Za-z0-9_.]+_(GroupsDropped|JoinRowsDropped)",
+        r"Sink_[a-z]+",
+        r"Batch_Files_Count",
+    )
+
+    @classmethod
+    def is_runtime_metric(cls, metric: str) -> bool:
+        """True when ``metric`` (the part after ``DATAX-<flow>:``) is a
+        registered engine-emitted name."""
+        import re
+
+        return any(
+            re.fullmatch(p, metric) for p in cls.RUNTIME_METRIC_PATTERNS
+        )
+
+    @staticmethod
+    def stage_metric(stage: str) -> str:
+        """Histogram stage -> its metric stem, e.g. ``device-step`` ->
+        ``Latency-DeviceStep``."""
+        camel = "".join(w.capitalize() for w in stage.split("-"))
+        return f"Latency-{camel}"
 
 
 class ProcessingPropertyName:

@@ -1,0 +1,150 @@
+"""Observability CLI.
+
+``python -m data_accelerator_tpu.obs trace <batch_id> [--file F] [--json]``
+reconstructs one micro-batch's span tree from the JSONL flight recorder
+(the ``tracefile`` writer of obs/telemetry.py). ``<batch_id>`` is the
+batch time in epoch ms (what ``streaming/batch/begin`` logs as
+``batchTime``) or a raw trace id.
+
+The rotated file (``<file>.1``) is read first when present, so a batch
+that rotated out mid-trace still reconstructs completely.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+
+def load_spans(path: str) -> List[dict]:
+    spans: List[dict] = []
+    for p in (path + ".1", path):
+        if not os.path.exists(p):
+            continue
+        with open(p, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("type") == "span":
+                    spans.append(rec)
+    return spans
+
+
+def find_traces(spans: List[dict], batch_id: str) -> List[str]:
+    """Trace ids whose root span matches ``batch_id`` (batchTime or
+    trace id)."""
+    ids: List[str] = []
+    for s in spans:
+        if s.get("trace") == batch_id and s["trace"] not in ids:
+            ids.append(s["trace"])
+    for s in spans:
+        if s.get("parent") is None:
+            bt = (s.get("properties") or {}).get("batchTime")
+            if bt is not None and str(bt) == str(batch_id) \
+                    and s["trace"] not in ids:
+                ids.append(s["trace"])
+    return ids
+
+
+def format_tree(spans: List[dict]) -> str:
+    """Render one trace's spans as an indented tree ordered by start."""
+    by_id: Dict[str, dict] = {s["span"]: s for s in spans}
+    children: Dict[Optional[str], List[dict]] = {}
+    for s in spans:
+        parent = s.get("parent")
+        if parent is not None and parent not in by_id:
+            parent = None  # orphan (rotation cut its parent) -> top level
+        children.setdefault(parent, []).append(s)
+    for kids in children.values():
+        kids.sort(key=lambda s: (s.get("startTs") or 0))
+
+    lines: List[str] = []
+
+    def emit(span: dict, prefix: str, is_last: bool, depth: int) -> None:
+        props = span.get("properties") or {}
+        extras = " ".join(
+            f"{k}={v}" for k, v in sorted(props.items())
+        )
+        dur = span.get("durationMs")
+        head = "" if depth == 0 else prefix + ("└─ " if is_last else "├─ ")
+        lines.append(
+            f"{head}{span.get('name')} "
+            f"{dur:.2f} ms" + (f"  [{extras}]" if extras else "")
+        )
+        kids = children.get(span["span"], [])
+        child_prefix = (
+            "" if depth == 0 else prefix + ("   " if is_last else "│  ")
+        )
+        for i, k in enumerate(kids):
+            emit(k, child_prefix, i == len(kids) - 1, depth + 1)
+
+    roots = children.get(None, [])
+    for i, r in enumerate(roots):
+        emit(r, "", i == len(roots) - 1, 0)
+    return "\n".join(lines)
+
+
+def cmd_trace(args) -> int:
+    spans = load_spans(args.file)
+    if not spans:
+        print(f"no spans found in {args.file}", file=sys.stderr)
+        return 2
+    trace_ids = find_traces(spans, args.batch_id)
+    if not trace_ids:
+        roots = sorted(
+            {
+                str((s.get("properties") or {}).get("batchTime"))
+                for s in spans
+                if s.get("parent") is None
+                and (s.get("properties") or {}).get("batchTime") is not None
+            }
+        )
+        print(
+            f"no trace for batch {args.batch_id!r}; known batch ids: "
+            f"{', '.join(roots[-10:]) or '(none)'}",
+            file=sys.stderr,
+        )
+        return 1
+    for tid in trace_ids:
+        tspans = [s for s in spans if s.get("trace") == tid]
+        if args.json:
+            print(json.dumps(tspans, indent=1, default=str))
+            continue
+        print(f"trace {tid} ({len(tspans)} span(s))")
+        print(format_tree(tspans))
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m data_accelerator_tpu.obs",
+        description="Observability tools over the JSONL flight recorder.",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    tp = sub.add_parser(
+        "trace", help="reconstruct one batch's span tree"
+    )
+    tp.add_argument("batch_id", help="batch time in epoch ms, or a trace id")
+    tp.add_argument(
+        "--file",
+        default=os.environ.get("DATAX_TRACE_FILE", "telemetry.jsonl"),
+        help="JSONL flight-recorder path (default: $DATAX_TRACE_FILE "
+             "or ./telemetry.jsonl)",
+    )
+    tp.add_argument("--json", action="store_true", help="raw span JSON")
+    args = parser.parse_args(argv)
+    if args.cmd == "trace":
+        return cmd_trace(args)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

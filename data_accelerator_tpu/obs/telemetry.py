@@ -41,20 +41,46 @@ class LogWriter(TelemetryWriter):
 
 
 class JsonlWriter(TelemetryWriter):
-    """Append-only JSONL trace file — the local flight recorder."""
+    """Append-only JSONL trace file — the local flight recorder.
 
-    def __init__(self, path: str):
+    Size-capped: when the file would exceed ``max_bytes`` it rotates to
+    ``<path>.1`` (replacing any previous rotation), so a long-running
+    job keeps at most ~2x the cap on disk while the trace CLI can still
+    see up to a full cap of history in the rotated file.
+    """
+
+    DEFAULT_MAX_BYTES = 64 * 1024 * 1024
+
+    def __init__(self, path: str, max_bytes: int = DEFAULT_MAX_BYTES):
         self.path = path
+        self.max_bytes = max_bytes
         self._lock = threading.Lock()
         parent = os.path.dirname(os.path.abspath(path))
         if parent:
             os.makedirs(parent, exist_ok=True)
+        try:
+            self._size = os.path.getsize(path)
+        except OSError:
+            self._size = 0
+
+    @property
+    def rotated_path(self) -> str:
+        return self.path + ".1"
 
     def write(self, record: Dict[str, Any]) -> None:
-        line = json.dumps(record, default=str)
+        line = json.dumps(record, default=str) + "\n"
+        data = line.encode("utf-8")
         with self._lock:
+            if self.max_bytes and self._size + len(data) > self.max_bytes \
+                    and self._size > 0:
+                try:
+                    os.replace(self.path, self.rotated_path)
+                except OSError:
+                    pass  # rotation failure must not lose the record
+                self._size = 0
             with open(self.path, "a", encoding="utf-8") as f:
-                f.write(line + "\n")
+                f.write(line)
+            self._size += len(data)
 
 
 class HttpWriter(TelemetryWriter):
@@ -152,6 +178,30 @@ class TelemetryLogger:
             "properties": properties or {},
         })
 
+    def track_span(
+        self,
+        name: str,
+        trace_id: str,
+        span_id: str,
+        parent_id: Optional[str],
+        start_ts: float,
+        duration_ms: float,
+        properties: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """One batch-stage span (obs/tracing.py) — written through the
+        same fan-out as events, so the JSONL flight recorder is also the
+        trace log the ``obs trace`` CLI reconstructs from."""
+        self._emit({
+            "type": "span",
+            "name": name,
+            "trace": trace_id,
+            "span": span_id,
+            "parent": parent_id,
+            "startTs": start_ts,
+            "durationMs": round(float(duration_ms), 4),
+            "properties": properties or {},
+        })
+
     def track_metric(self, name: str, value: float,
                      properties: Optional[Dict[str, Any]] = None) -> None:
         self._emit({
@@ -181,7 +231,14 @@ def from_conf(dict_) -> TelemetryLogger:
     writers: List[TelemetryWriter] = [LogWriter()]
     trace = sub.get("tracefile")
     if trace:
-        writers.append(JsonlWriter(trace))
+        max_bytes = sub.get_long_option("tracefilemaxbytes")
+        writers.append(JsonlWriter(
+            trace,
+            max_bytes=(
+                max_bytes if max_bytes is not None
+                else JsonlWriter.DEFAULT_MAX_BYTES
+            ),
+        ))
     endpoint = sub.get("httppost")
     if endpoint:
         writers.append(HttpWriter(endpoint))

@@ -28,8 +28,10 @@ from typing import Dict, List, Optional, Tuple
 
 from ..core.config import SettingDictionary
 from ..core.confmanager import ConfigManager
-from ..obs import telemetry
+from ..obs import telemetry, tracing
+from ..obs.histogram import HISTOGRAMS
 from ..obs.metrics import MetricLogger
+from ..obs.tracing import Tracer
 from ..utils import fs
 from .processor import FlowProcessor
 from .sinks import OutputDispatcher, build_output_operators
@@ -118,6 +120,19 @@ class BatchHost:
         self.processor = FlowProcessor(dict_, udfs=udfs)
         self.metric_logger = MetricLogger.from_conf(dict_)
         self.telemetry = telemetry.from_conf(dict_)
+        # same span/histogram surface as the streaming host: each chunk
+        # is one trace (decode -> dispatch -> device-step -> sync ->
+        # collect -> sinks), so batch and streaming latency live in one
+        # measurement vocabulary
+        tele_conf = dict_.get_sub_dictionary("datax.job.process.telemetry.")
+        self.tracer = Tracer(
+            self.telemetry,
+            histograms=HISTOGRAMS,
+            flow=dict_.get_job_name(),
+            enabled=(
+                tele_conf.get_or_else("tracing", "true") or ""
+            ).lower() != "false",
+        )
         if table_sink_map is None:
             from ..core.config import SettingNamespace
 
@@ -178,12 +193,19 @@ class BatchHost:
         totals: Dict[str, float] = {"Batch_Files_Count": float(len(files))}
         rows: List[dict] = []
         batch_time_ms = int(t0 * 1000)
-        pending = None  # one chunk in flight (P6 overlap for batch mode)
+        pending = None  # one (handle, trace) in flight (P6 overlap)
 
-        def finish(handle) -> None:
-            datasets, metrics = handle.collect()
-            self.dispatcher.dispatch(datasets, batch_time_ms)
+        def finish(handle, trace) -> None:
+            with trace.activate():
+                with tracing.span("sync"):
+                    handle.block_until_evaluated()
+                trace.record_since("device-step", "dispatch-done")
+                with tracing.span("collect"):
+                    datasets, metrics = handle.collect()
+                with tracing.span("sinks"):
+                    self.dispatcher.dispatch(datasets, batch_time_ms)
             self.processor.commit()
+            trace.end()
             for k, v in metrics.items():
                 # counts sum across chunks; point-in-time / per-chunk
                 # latency values don't (a pipelined chunk's
@@ -198,11 +220,17 @@ class BatchHost:
             # same overlap as StreamingHost.run_pipelined, so file reads
             # and sink writes hide under the device step
             nonlocal pending
-            raw = self.processor.encode_rows(chunk, (batch_time_ms // 1000) * 1000)
-            handle = self.processor.dispatch_batch(raw, batch_time_ms)
+            trace = self.tracer.begin("batch/chunk", batchTime=batch_time_ms)
+            with trace.activate(), tracing.span("decode", rows=len(chunk)):
+                raw = self.processor.encode_rows(
+                    chunk, (batch_time_ms // 1000) * 1000
+                )
+            with trace.activate(), tracing.span("dispatch"):
+                handle = self.processor.dispatch_batch(raw, batch_time_ms)
+            trace.mark("dispatch-done")
             if pending is not None:
-                finish(pending)
-            pending = handle
+                finish(*pending)
+            pending = (handle, trace)
 
         try:
             for f in files:
@@ -213,10 +241,12 @@ class BatchHost:
             if rows:
                 flush(rows)
             if pending is not None:
-                finish(pending)
+                finish(*pending)
                 pending = None
         except Exception as e:
             self.telemetry.track_exception(e, {"event": "error/batch/process"})
+            if pending is not None:
+                pending[1].end(status="error")  # idempotent
             raise
         # tracker written only after a fully successful pass (at-least-once)
         self._processed.update(files)

@@ -17,6 +17,8 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from ..obs.tracing import span as _trace_span
+
 
 @dataclass(frozen=True)
 class PartitionOffset:
@@ -91,15 +93,16 @@ class OffsetCheckpointer:
         self, consumed: Dict[Tuple[str, int], Tuple[int, int]]
     ) -> None:
         """consumed: (source, partition) -> (from_seq, until_seq)."""
-        now = int(time.time() * 1000)
-        merged: Dict[Tuple[str, int], PartitionOffset] = {
-            (o.source, o.partition): o for o in self.read_offsets()
-        }
-        for (source, part), (from_seq, until_seq) in consumed.items():
-            merged[(source, part)] = PartitionOffset(
-                now, source, part, from_seq, until_seq
-            )
-        self.write_offsets(list(merged.values()))
+        with _trace_span("checkpoint/offsets"):
+            now = int(time.time() * 1000)
+            merged: Dict[Tuple[str, int], PartitionOffset] = {
+                (o.source, o.partition): o for o in self.read_offsets()
+            }
+            for (source, part), (from_seq, until_seq) in consumed.items():
+                merged[(source, part)] = PartitionOffset(
+                    now, source, part, from_seq, until_seq
+                )
+            self.write_offsets(list(merged.values()))
 
 
 class WindowStateCheckpointer:
@@ -135,6 +138,10 @@ class WindowStateCheckpointer:
 
     def save(self, snap: Dict) -> None:
         """snap: FlowProcessor.snapshot_window_state() output."""
+        with _trace_span("checkpoint/window"):
+            self._save(snap)
+
+    def _save(self, snap: Dict) -> None:
         import numpy as np
 
         arrays: Dict[str, "np.ndarray"] = {}

@@ -18,10 +18,14 @@ import threading
 import time
 from typing import Dict, Optional
 
+from ..constants import MetricName
 from ..core.config import SettingDictionary, SettingNamespace
 from ..core.confmanager import ConfigManager
-from ..obs import telemetry
+from ..obs import telemetry, tracing
+from ..obs.exposition import HealthState, ObservabilityServer
+from ..obs.histogram import HISTOGRAMS
 from ..obs.metrics import MetricLogger
+from ..obs.tracing import Tracer
 from .checkpoint import OffsetCheckpointer, WindowStateCheckpointer
 from .processor import FlowProcessor
 from .sinks import OutputDispatcher, build_output_operators
@@ -44,6 +48,22 @@ class StreamingHost:
         # lifecycle telemetry (AppInsightLogger analog): batch begin/end
         # events + exceptions with app context (AppInsightLogger.scala:18-108)
         self.telemetry = telemetry.from_conf(dict_)
+        # batch-granular span tracing + per-stage latency histograms
+        # (obs/tracing.py, obs/histogram.py): every stage boundary of
+        # every micro-batch is a span in the telemetry fan-out and a
+        # sample in the stage's live latency distribution. Span emission
+        # is conf-gated (process.telemetry.tracing, default on — the
+        # overhead is a handful of clock reads per batch); histograms
+        # always observe, they are the /metrics + percentile source.
+        tele_conf0 = dict_.get_sub_dictionary("datax.job.process.telemetry.")
+        self.tracer = Tracer(
+            self.telemetry,
+            histograms=HISTOGRAMS,
+            flow=dict_.get_job_name(),
+            enabled=(
+                tele_conf0.get_or_else("tracing", "true") or ""
+            ).lower() != "false",
+        )
 
         input_conf = dict_.get_sub_dictionary(SettingNamespace.JobInputPrefix)
         # one StreamingSource per declared input source (multi-source
@@ -100,6 +120,31 @@ class StreamingHost:
             input_conf.get_duration_option("eventhub.checkpointinterval") or 60.0
         )
         self._last_checkpoint = 0.0
+
+        # health/readiness state + the Prometheus/health HTTP surface
+        # (/metrics, /healthz, /readyz — obs/exposition.py), served when
+        # process.observability.port is set (0 = ephemeral port, useful
+        # for tests and one-box)
+        self.health = HealthState(
+            flow=dict_.get_job_name(),
+            checkpoint_interval_s=(
+                self.checkpoint_interval_s if self.checkpointer else None
+            ),
+            batch_interval_s=self.interval_s,
+        )
+        self.obs_server: Optional[ObservabilityServer] = None
+        obs_port = dict_.get_sub_dictionary(
+            SettingNamespace.JobProcessPrefix + "observability."
+        ).get_int_option("port")
+        if obs_port is not None:
+            self.obs_server = ObservabilityServer(
+                self.health,
+                histograms=HISTOGRAMS,
+                store=self.metric_logger.store,
+                port=obs_port,
+            )
+            self.obs_server.start()
+
         if self.checkpointer:
             positions = self.checkpointer.starting_positions()
             for s in self.sources.values():
@@ -174,21 +219,35 @@ class StreamingHost:
             consumed.update(c)
         return raw, consumed, batch_time_ms, t0
 
-    def _finish(self, handle, consumed, batch_time_ms, t0) -> Dict[str, float]:
+    def _finish(self, handle, consumed, batch_time_ms, t0, trace) -> Dict[str, float]:
         """Collect a batch and run its tail: sinks -> commit -> ack ->
         metrics -> checkpoint. Failures requeue un-acked source batches
         and rethrow so the batch retries, at-least-once
-        (CommonProcessorFactory.scala:382-398)."""
+        (CommonProcessorFactory.scala:382-398). Every stage is a span of
+        the batch's trace and a sample in its stage histogram."""
         try:
-            datasets, metrics = handle.collect()
-            self.dispatcher.dispatch(datasets, batch_time_ms)
-            self.processor.commit()
-            for s in self.sources.values():
-                s.ack()
+            with trace.activate():
+                with tracing.span("sync"):
+                    # completion handshake first, so the trace separates
+                    # "rules evaluated" (device-step ends here) from
+                    # result transport + materialization (collect)
+                    handle.block_until_evaluated()
+                trace.record_since("device-step", "dispatch-done")
+                with tracing.span("collect"):
+                    datasets, metrics = handle.collect()
+                with tracing.span("sinks"):
+                    self.dispatcher.dispatch(datasets, batch_time_ms)
+                self.processor.commit()
+                for s in self.sources.values():
+                    s.ack()
         except Exception as e:
             self.telemetry.track_exception(
                 e, {"event": "error/streaming/process", "batchTime": batch_time_ms}
             )
+            self.health.record_batch(
+                batch_time_ms, ok=False, error=f"{type(e).__name__}: {e}"
+            )
+            trace.end(status="error")
             for s in self.sources.values():
                 s.requeue_unacked()
             logger.exception("batch processing failed; rethrowing for retry")
@@ -196,6 +255,16 @@ class StreamingHost:
 
         metrics["Latency-Batch"] = (time.time() - t0) * 1000.0
         metrics["IngestRateScale"] = self._rate_scale
+        # per-stage latency percentiles from the live histograms — the
+        # DATAX-<flow>:Latency-<Stage>-pNN series the dashboard's stat
+        # tiles and stage timechart read (obs/histogram.py keeps these
+        # exact over a bounded recent-sample window)
+        for stage in MetricName.STAGES:
+            stem = MetricName.stage_metric(stage)
+            for q in (50, 95, 99):
+                v = HISTOGRAMS.percentile(self.health.flow, stage, q)
+                if v is not None:
+                    metrics[f"{stem}-p{q}"] = v
         self.telemetry.batch_end(batch_time_ms, {"latencyMs": metrics["Latency-Batch"]})
         self.metric_logger.send_batch_metrics(metrics, batch_time_ms)
         logger.info(
@@ -206,18 +275,25 @@ class StreamingHost:
         if self.checkpointer and (
             t0 - self._last_checkpoint >= self.checkpoint_interval_s
         ):
-            if self.window_checkpointer:
-                # snapshot BEFORE offsets: a crash between the two leaves
-                # old offsets + new rings, so replayed batches land in
-                # rings that already contain them (at-least-once
-                # duplicates); the reverse order would resume PAST events
-                # the restored rings never saw — a hole in window history
-                self.window_checkpointer.save(
-                    self.processor.snapshot_window_state()
-                )
-            self.checkpointer.checkpoint_batch(consumed)
+            with trace.activate(), tracing.span("checkpoint"):
+                if self.window_checkpointer:
+                    # snapshot BEFORE offsets: a crash between the two leaves
+                    # old offsets + new rings, so replayed batches land in
+                    # rings that already contain them (at-least-once
+                    # duplicates); the reverse order would resume PAST events
+                    # the restored rings never saw — a hole in window history
+                    self.window_checkpointer.save(
+                        self.processor.snapshot_window_state()
+                    )
+                self.checkpointer.checkpoint_batch(consumed)
             self._last_checkpoint = t0
+            self.health.record_checkpoint()
         self.batches_processed += 1
+        self.health.record_batch(
+            batch_time_ms, ok=True, latency_ms=metrics["Latency-Batch"]
+        )
+        self.health.record_watermark(batch_time_ms)
+        trace.end()
         return metrics
 
     def _profiler_tick(self) -> None:
@@ -242,20 +318,41 @@ class StreamingHost:
                     "jax profiler trace written to %s", self._profiler_dir
                 )
 
+    def _traced_poll(self, trace):
+        """Poll + encode under the batch's trace (the pipelined loop
+        runs this on the decode-ahead worker thread, so the span needs
+        explicit activation there)."""
+        with trace.activate(), tracing.span("decode"):
+            return self._poll_and_encode()
+
+    def _dispatch_traced(self, trace, raw, batch_time_ms):
+        """Dispatch under the batch's trace, marking the dispatch-done
+        instant the later device-step span measures from."""
+        trace.add(batchTime=batch_time_ms)
+        self.telemetry.batch_begin(batch_time_ms)
+        with trace.activate(), tracing.span("dispatch"):
+            handle = self.processor.dispatch_batch(raw, batch_time_ms)
+        trace.mark("dispatch-done")
+        return handle
+
     def _start_batch(self):
         """Poll + encode + dispatch one batch; a failure anywhere here
         (bad payload, re-trace error) requeues the polled batch so a
         later batch's ack can't release it unprocessed."""
         self._profiler_tick()
+        trace = self.tracer.begin("streaming/batch")
         try:
-            raw, consumed, batch_time_ms, t0 = self._poll_and_encode()
-            self.telemetry.batch_begin(batch_time_ms)
-            handle = self.processor.dispatch_batch(raw, batch_time_ms)
-        except Exception:
+            raw, consumed, batch_time_ms, t0 = self._traced_poll(trace)
+            handle = self._dispatch_traced(trace, raw, batch_time_ms)
+        except Exception as e:
+            self.health.record_batch(
+                None, ok=False, error=f"{type(e).__name__}: {e}"
+            )
+            trace.end(status="error")
             for s in self.sources.values():
                 s.requeue_unacked()
             raise
-        return handle, consumed, batch_time_ms, t0
+        return handle, consumed, batch_time_ms, t0, trace
 
     def _update_backpressure(self, busy_ms: float) -> None:
         """Adaptive backpressure on the loop's *busy* time (work per
@@ -307,9 +404,10 @@ class StreamingHost:
         rethrowing."""
         from concurrent.futures import ThreadPoolExecutor
 
-        pending = None  # (PendingBatch, consumed offsets, batch_time_ms, t0)
+        pending = None  # (PendingBatch, consumed, batch_time_ms, t0, trace)
         pool = ThreadPoolExecutor(1)
         fut = None
+        fut_trace = None  # the trace of the batch `fut` is decoding
 
         def drain(f):
             """Wait out an in-flight poll so its delivery lands in the
@@ -334,11 +432,11 @@ class StreamingHost:
                 iter_t0 = time.time()
                 self._profiler_tick()
                 if fut is None:
-                    fut = pool.submit(self._poll_and_encode)
+                    fut_trace = self.tracer.begin("streaming/batch")
+                    fut = pool.submit(self._traced_poll, fut_trace)
                 raw, consumed, batch_time_ms, t0 = fut.result()
-                fut = None
-                self.telemetry.batch_begin(batch_time_ms)
-                handle = self.processor.dispatch_batch(raw, batch_time_ms)
+                trace, fut, fut_trace = fut_trace, None, None
+                handle = self._dispatch_traced(trace, raw, batch_time_ms)
                 # decode-ahead: the NEXT batch's poll starts now,
                 # overlapping the previous batch's collect + sinks —
                 # but only if a next iteration will actually run
@@ -348,13 +446,14 @@ class StreamingHost:
                 if not self._stop and (
                     max_batches is None or started < max_batches
                 ):
-                    fut = pool.submit(self._poll_and_encode)
+                    fut_trace = self.tracer.begin("streaming/batch")
+                    fut = pool.submit(self._traced_poll, fut_trace)
                 if pending is not None:
                     self._finish(*pending)
                 # backpressure on iteration time, not Latency-Batch: a
                 # pipelined batch's latency spans ~2 iterations by design
                 self._update_backpressure((time.time() - iter_t0) * 1000.0)
-                pending = (handle, consumed, batch_time_ms, t0)
+                pending = (handle, consumed, batch_time_ms, t0, trace)
             if pending is not None and not self._stop:
                 self._finish(*pending)
         except Exception:
@@ -364,11 +463,17 @@ class StreamingHost:
             # is idempotent)
             drain(fut)
             fut = None
+            if fut_trace is not None:
+                fut_trace.end(status="aborted")
+            if pending is not None:
+                pending[4].end(status="aborted")  # idempotent
             for s in self.sources.values():
                 s.requeue_unacked()
             raise
         finally:
             drain(fut)
+            if fut_trace is not None:
+                fut_trace.end(status="aborted")  # idempotent
             pool.shutdown(wait=False, cancel_futures=True)
             self._stop_profiler()
 
@@ -387,6 +492,9 @@ class StreamingHost:
     def stop(self) -> None:
         self._stop = True
         self._stop_profiler()
+        if self.obs_server is not None:
+            self.obs_server.stop()
+            self.obs_server = None
         for s in self.sources.values():
             s.close()
 

@@ -40,6 +40,7 @@ from ..compile.sqlparser import parse_select
 from ..compile.transform_parser import TransformParser
 from ..constants import ColumnName, DatasetName
 from ..core.config import EngineException, SettingDictionary, SettingNamespace
+from ..obs.tracing import span as _trace_span
 from ..core.schema import ColType, Schema, StringDictionary
 from .materialize import materialize_rows
 from .statetable import StateTable
@@ -1100,11 +1101,14 @@ class FlowProcessor:
         # (so they cover every id the batch can contain), cached until the
         # dictionary grows; growth past table capacity retraces the step
         aux = self.aux_tables.tables()
-        out_datasets, new_rings, new_state, counts_vec = self._step(
-            raw, self.window_buffers, self.state_data, refdata_tables,
-            base_s, now_rel_ms, counter, jnp.asarray(delta_ms, jnp.int32),
-            aux,
-        )
+        # child span of the host's "dispatch" when a batch trace is
+        # active (obs/tracing.py); a no-op under bench/LiveQuery drivers
+        with _trace_span("device-enqueue"):
+            out_datasets, new_rings, new_state, counts_vec = self._step(
+                raw, self.window_buffers, self.state_data, refdata_tables,
+                base_s, now_rel_ms, counter, jnp.asarray(delta_ms, jnp.int32),
+                aux,
+            )
         # carry device state forward without materializing — the next
         # dispatch may consume these handles before this batch collects
         self.window_buffers = new_rings
@@ -1232,16 +1236,17 @@ class PendingBatch:
         one batched device_get.
         """
         proc = self.proc
-        if self._prefetched or proc.batch_capacity <= SMALL_FETCH_ROWS:
-            # whole-table transfer in ONE round trip (counts + outputs
-            # together) — prefetched at dispatch, or small enough that
-            # the extra bytes cost less than a second host<->device sync
-            counts, host_full = jax.device_get(
-                (self.counts_vec, self.out_datasets)
-            )
-        else:
-            counts = np.asarray(self.counts_vec)
-            host_full = None
+        with _trace_span("device-fetch"):
+            if self._prefetched or proc.batch_capacity <= SMALL_FETCH_ROWS:
+                # whole-table transfer in ONE round trip (counts + outputs
+                # together) — prefetched at dispatch, or small enough that
+                # the extra bytes cost less than a second host<->device sync
+                counts, host_full = jax.device_get(
+                    (self.counts_vec, self.out_datasets)
+                )
+            else:
+                counts = np.asarray(self.counts_vec)
+                host_full = None
         # unpack in PACKING order (snapshotted at dispatch) — jax returns
         # dict pytrees with sorted keys, so iterating out_datasets may
         # not match the order the step packed counts in
@@ -1281,20 +1286,22 @@ class PendingBatch:
         )
 
         datasets: Dict[str, List[dict]] = {}
-        for name, table in host_tables.items():
-            rows = materialize_rows(
-                table, self.pipeline.schema_of(name), proc.dictionary,
-                self.base_ms,
-            )
-            view = self.pipeline.view_by_name(name)
-            if view is not None and view.host_order:
-                # ORDER BY over computed-string columns: the device has
-                # no id to sort by, so the ordering (and limit) applies
-                # to the materialized rows (planner host-order path)
-                _host_sort(rows, view.host_order)
-                if view.host_limit is not None:
-                    rows = rows[: view.host_limit]
-            datasets[name] = rows
+        with _trace_span("materialize"):
+            for name, table in host_tables.items():
+                rows = materialize_rows(
+                    table, self.pipeline.schema_of(name), proc.dictionary,
+                    self.base_ms,
+                )
+                view = self.pipeline.view_by_name(name)
+                if view is not None and view.host_order:
+                    # ORDER BY over computed-string columns: the device
+                    # has no id to sort by, so the ordering (and limit)
+                    # applies to the materialized rows (planner
+                    # host-order path)
+                    _host_sort(rows, view.host_order)
+                    if view.host_limit is not None:
+                        rows = rows[: view.host_limit]
+                datasets[name] = rows
 
         # persist state tables (A/B overwrite; persist() is the caller's
         # post-sink commit, see StreamingHost) — from THIS batch's state

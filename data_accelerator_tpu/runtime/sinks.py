@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from ..core.config import SettingDictionary
+from ..obs import tracing
 from ..obs.metrics import MetricLogger
 from ..constants import MetricName
 from ..utils import fs
@@ -424,7 +425,13 @@ class OutputOperator:
     def write(self, rows: List[dict], batch_time_ms: int) -> Dict[str, int]:
         counts = {}
         for s in self.sinks:
-            counts[s.kind] = s.write(self.dataset, rows, batch_time_ms)
+            # one span per sink write under the batch trace (no-op when
+            # none is active) — makes a slow destination visible per
+            # batch instead of hiding inside the "sinks" stage total
+            with tracing.span(
+                f"sink/{s.kind}", dataset=self.dataset, rows=len(rows)
+            ):
+                counts[s.kind] = s.write(self.dataset, rows, batch_time_ms)
         return counts
 
 
@@ -547,10 +554,14 @@ class OutputDispatcher:
         threads = []
         lock = threading.Lock()
         errors: List[BaseException] = []
+        # carry the caller's batch trace onto the fan-out threads, so
+        # per-sink spans parent under the host's "sinks" span
+        trace_pos = tracing.capture()
 
         def run_op(name: str, op: OutputOperator, rows: List[dict]):
             try:
-                counts = op.write(rows, batch_time_ms)
+                with tracing.activated(trace_pos):
+                    counts = op.write(rows, batch_time_ms)
             except BaseException as e:  # noqa: BLE001 — re-raised after join
                 with lock:
                     errors.append(e)

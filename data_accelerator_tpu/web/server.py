@@ -20,6 +20,12 @@ TPU-native stand-in: one ThreadingHTTPServer.
                               poll — the store publishes on write).
 - ``/metrics/history``      — zrangebyscore backfill for a key.
 - ``/metrics/keys``         — known metric keys by prefix.
+- ``/metrics``              — Prometheus text exposition (per-stage
+                              latency histograms + latest gauge values;
+                              obs/exposition.py renders it, same dialect
+                              as every runtime host's own endpoint).
+- ``/healthz``, ``/readyz`` — liveness/readiness probes for the website
+                              process itself.
 - ``/composition``          — page registry (web.composition.json role).
 """
 
@@ -36,6 +42,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from ..obs.exposition import render_prometheus
+from ..obs.histogram import HISTOGRAMS
 from ..obs.store import METRIC_STORE, MetricStore
 
 logger = logging.getLogger(__name__)
@@ -130,6 +138,23 @@ class WebsiteServer:
                         except (KeyError, TypeError, ValueError):
                             continue
                     self._send_json(200, {"stored": n})
+                elif path == "/metrics" and method == "GET":
+                    # Prometheus scrape: stage histograms (one-box jobs
+                    # share the process HISTOGRAMS registry) + the latest
+                    # point of every MetricStore key as a gauge
+                    body = render_prometheus(HISTOGRAMS, ws.store).encode()
+                    self._send(
+                        200, body,
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                elif path == "/healthz":
+                    self._send_json(200, {"status": "ok", "role": "website"})
+                elif path == "/readyz":
+                    ready = ws.api is not None or ws.gateway_url is not None
+                    self._send_json(
+                        200 if ready else 503,
+                        {"ready": ready, "role": "website"},
+                    )
                 elif path == "/metrics/stream":
                     self._sse(parse_qs(parsed.query))
                 elif path == "/metrics/history":

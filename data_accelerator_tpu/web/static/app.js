@@ -518,7 +518,16 @@ function closeLiveFeeds() {
   while (liveFeeds.length) liveFeeds.pop().close();
 }
 
-const SERIES_VARS = ["--series-1", "--series-2", "--series-3"];
+const SERIES_VARS = ["--series-1", "--series-2", "--series-3",
+                     "--series-4", "--series-5", "--series-6"];
+
+/* canonical engine stages (constants.py MetricName.STAGES minus the
+   whole-batch rollup) and their Latency-<Stage> metric stems */
+const STAGES = ["decode", "dispatch", "device-step", "sync", "collect",
+                "sinks", "checkpoint"];
+const stageMetric = (s) =>
+  "Latency-" + s.split("-").map((w) => w[0].toUpperCase() + w.slice(1)).join("");
+const LATENCY_PCTL_RE = /^Latency-[A-Za-z]+-p(50|95|99)$/;
 
 function lineChart(container, title) {
   /* single-metric timechart: 2px line, crosshair+tooltip, recessive
@@ -605,6 +614,95 @@ function fmtVal(v) {
   return (+v).toFixed(Math.abs(v) < 10 && v % 1 ? 2 : 0);
 }
 
+function multiChart(container, title, seriesNames) {
+  /* multi-series timechart (per-stage latency): one 2px line per
+     series, shared scale, legend keyed to the categorical palette. */
+  const W = 800, H = 200, PL = 54, PB = 18, PT = 8;
+  const card = h("div", { class: "card chart-card" },
+    h("div", { class: "chart-title" }, title));
+  const wrap = h("div", { class: "chart-wrap" });
+  const svg = document.createElementNS("http://www.w3.org/2000/svg", "svg");
+  svg.setAttribute("viewBox", `0 0 ${W} ${H}`);
+  const tip = h("div", { class: "tooltip" });
+  wrap.append(svg, tip);
+  const colorOf = (name) =>
+    `var(${SERIES_VARS[seriesNames.indexOf(name) % SERIES_VARS.length]})`;
+  card.append(wrap, h("div", { class: "legend" }, seriesNames.map((n) =>
+    h("span", {},
+      h("span", { class: "sw", style: `background:${colorOf(n)}` }), n))));
+  container.append(card);
+  const data = {};  // series -> [{t, v}]
+  for (const n of seriesNames) data[n] = [];
+  const MAX_POINTS = 600;
+
+  function draw() {
+    svg.replaceChildren();
+    const all = seriesNames.flatMap((n) => data[n]);
+    if (all.length < 2) return;
+    const t0 = Math.min(...all.map((p) => p.t));
+    const t1 = Math.max(...all.map((p) => p.t));
+    let vmin = 0;  // latency: zero-based scale reads honestly
+    let vmax = Math.max(...all.map((p) => p.v));
+    if (vmax <= vmin) vmax = vmin + 1;
+    const x = (t) => PL + (W - PL - 8) * (t - t0) / Math.max(1, t1 - t0);
+    const y = (v) => PT + (H - PT - PB) * (1 - (v - vmin) / (vmax - vmin));
+    const mk = (n, attrs) => {
+      const el = document.createElementNS("http://www.w3.org/2000/svg", n);
+      for (const [k, v] of Object.entries(attrs)) el.setAttribute(k, v);
+      svg.append(el);
+      return el;
+    };
+    for (const frac of [0, 0.5, 1]) {
+      const v = vmin + (vmax - vmin) * frac;
+      mk("line", { x1: PL, x2: W - 8, y1: y(v), y2: y(v), class: "grid-line" });
+      const t = mk("text", { x: PL - 6, y: y(v) + 3, "text-anchor": "end" });
+      t.textContent = fmtVal(v);
+      t.setAttribute("fill", "var(--text-muted)");
+      t.setAttribute("font-size", "10");
+    }
+    for (const name of seriesNames) {
+      const pts = data[name];
+      if (pts.length < 2) continue;
+      const d = pts.map((p, i) =>
+        `${i ? "L" : "M"}${x(p.t).toFixed(1)},${y(p.v).toFixed(1)}`).join("");
+      mk("path", { d, class: "series", stroke: colorOf(name) });
+    }
+    svg.onmousemove = (ev) => {
+      const rect = svg.getBoundingClientRect();
+      const mx = (ev.clientX - rect.left) * W / rect.width;
+      const my = (ev.clientY - rect.top) * H / rect.height;
+      let best = null, bd = Infinity;
+      for (const name of seriesNames) {
+        for (const p of data[name]) {
+          const dd = Math.abs(x(p.t) - mx) + Math.abs(y(p.v) - my) / 4;
+          if (dd < bd) { bd = dd; best = { ...p, name }; }
+        }
+      }
+      if (!best) return;
+      tip.style.display = "block";
+      tip.style.left = `${(x(best.t) / W) * rect.width + 12}px`;
+      tip.style.top = `${(y(best.v) / H) * rect.height - 10}px`;
+      tip.textContent =
+        `${best.name} — ${new Date(best.t).toLocaleTimeString()} — ${fmtVal(best.v)} ms`;
+    };
+    svg.onmouseleave = () => { tip.style.display = "none"; };
+  }
+  return {
+    push(name, t, v) {
+      if (!data[name]) return;
+      data[name].push({ t, v });
+      if (data[name].length > MAX_POINTS) data[name].shift();
+      draw();
+    },
+    seed(name, points) {
+      if (!data[name]) return;
+      data[name].splice(0, data[name].length,
+        ...points.map((p) => ({ t: p.uts, v: +p.val })));
+      draw();
+    },
+  };
+}
+
 route("#/metrics", async (view, hash) => {
   const flow = hash.split("/")[2] || "";
   view.append(h("h1", {}, flow ? `Metrics — ${flow}` : "Metrics"));
@@ -617,13 +715,49 @@ route("#/metrics", async (view, hash) => {
   if (!flow) return;
 
   const prefix = `DATAX-${flow}:`;
+
+  /* latency percentile stat tiles (whole-batch p50/p95/p99, live from
+     the engine's per-stage histograms) + per-stage p95 timechart */
+  const pctlTiles = h("div", { class: "tiles" });
+  const PCTLS = ["p50", "p95", "p99"];
+  const pctlEls = {};
+  for (const p of PCTLS) {
+    const tile = h("div", { class: "tile" },
+      h("div", { class: "k" }, `batch latency ${p}`),
+      h("div", { class: "v" }, "–", h("span", { class: "u" }, "ms")));
+    pctlTiles.append(tile);
+    pctlEls[`Latency-Batch-${p}`] = $(".v", tile);
+  }
+  view.append(h("h2", {}, "Latency percentiles"), pctlTiles);
+  const stageChartBox = h("div", {});
+  view.append(stageChartBox);
+  const STAGE_PCTL = "p95";
+  const stageChart = multiChart(
+    stageChartBox, `Per-stage latency ${STAGE_PCTL} (ms)`, STAGES);
+  const stageKeyOf = {};  // metric -> stage
+  for (const s of STAGES) stageKeyOf[`${stageMetric(s)}-${STAGE_PCTL}`] = s;
+
   const tiles = h("div", { class: "tiles" });
   const charts = h("div", {});
-  view.append(tiles, charts);
+  view.append(h("h2", {}, "Engine metrics"), tiles, charts);
 
   const tileEls = {};   // metric -> value el
   const chartEls = {};  // metric -> chart handle
   const latest = {};
+
+  const routePoint = (metric, point) => {
+    /* percentile series feed the dedicated tiles/stage chart instead of
+       spawning one generic chart per metric (24 series otherwise) */
+    if (pctlEls[metric]) {
+      pctlEls[metric].childNodes[0].textContent = fmtVal(point.val);
+      return true;
+    }
+    if (stageKeyOf[metric]) {
+      stageChart.push(stageKeyOf[metric], point.uts, point.val);
+      return true;
+    }
+    return LATENCY_PCTL_RE.test(metric);  // other pctls: tracked, unplotted
+  };
 
   const ensure = async (metric) => {
     if (chartEls[metric]) return;
@@ -638,9 +772,22 @@ route("#/metrics", async (view, hash) => {
     chartEls[metric].seed(history.slice(-300));
   };
 
+  const seedLatency = async (metric) => {
+    const history = await fetch(
+      `/metrics/history?key=${encodeURIComponent(prefix + metric)}`).then((r) => r.json());
+    if (!history.length) return;
+    if (stageKeyOf[metric]) {
+      stageChart.seed(stageKeyOf[metric], history.slice(-300));
+    }
+    routePoint(metric, history[history.length - 1]);
+  };
+
   const keys = await fetch(`/metrics/keys?prefix=${encodeURIComponent(prefix)}`)
     .then((r) => r.json());
-  await Promise.all(keys.sort().map((k) => ensure(k.slice(prefix.length))));
+  await Promise.all(keys.sort().map((k) => {
+    const metric = k.slice(prefix.length);
+    return LATENCY_PCTL_RE.test(metric) ? seedLatency(metric) : ensure(metric);
+  }));
 
   const es = new EventSource(`/metrics/stream?prefix=${encodeURIComponent(prefix)}`);
   liveFeeds.push(es);
@@ -650,6 +797,7 @@ route("#/metrics", async (view, hash) => {
     let point;
     try { point = JSON.parse(member); } catch { return; }
     if (typeof point.val !== "number") return;
+    if (routePoint(metric, point)) return;
     await ensure(metric);
     latest[metric] = point.val;
     tileEls[metric].textContent = fmtVal(point.val);

@@ -152,11 +152,79 @@ def test_join_on_concat_null_never_matches():
     assert rows == []
 
 
-def test_concat_of_cast_numeric_still_rejected_with_clear_error():
-    with pytest.raises(EngineException, match="CAST of numeric"):
+def test_where_concat_of_cast_numeric_equals_literal():
+    """Stringified integers are first-class: the device hashes the
+    decimal rendering of CAST(n AS STRING) directly (exprs._int_str_hash),
+    so CONCAT over it compares against literals."""
+    rows, _, _ = run_sql(
+        "SELECT n FROM T WHERE CONCAT(cluster, CAST(n AS STRING)) = 'east1'",
+        {"T": (T, TT)},
+    )
+    assert [r["n"] for r in rows] == [1]
+
+
+def test_cast_numeric_hash_matches_host_rendering():
+    """Device digit-hash == host poly_hash(str(n)) across sign/width
+    edge cases, for both hash multipliers."""
+    import jax.numpy as jnp
+
+    from data_accelerator_tpu.compile.exprs import _int_str_hash
+    from data_accelerator_tpu.compile.stringops import (
+        HASH_P1, HASH_P2, poly_hash, pow_len,
+    )
+
+    values = [0, 1, 9, 10, 42, 99, 100, 12345, 10**9, 2**31 - 1,
+              -1, -7, -10, -999999, -(2**31)]
+    arr = jnp.asarray(values, jnp.int32)
+    for p in (HASH_P1, HASH_P2):
+        h, pl = _int_str_hash(arr, p)
+        for i, v in enumerate(values):
+            assert int(np.asarray(h)[i]) == poly_hash(str(v), p), (v, p)
+            assert int(np.asarray(pl)[i]) == pow_len(str(v), p), (v, p)
+
+
+def test_group_by_concat_with_cast_numeric():
+    cols = {"cluster": ["east", "east", "west"], "n": [1, 1, 1],
+            "x": [10, 20, 30]}
+    tt = {"cluster": "string", "n": "long", "x": "long"}
+    rows, _, _ = run_sql(
+        "SELECT COUNT(*) AS c FROM T GROUP BY CONCAT(cluster, CAST(n AS STRING))",
+        {"T": (cols, tt)},
+    )
+    assert sorted(r["c"] for r in rows) == [1, 2]  # east1 x2, west1 x1
+
+
+def test_join_on_concat_with_cast_numeric():
+    left = {"cluster": ["east", "west", "east"], "n": [1, 2, 7]}
+    right = {"key": ["east1", "west2", "east3"], "v": [10, 20, 30]}
+    rows, _, _ = run_sql(
+        "SELECT l.n, r.v FROM L l INNER JOIN R r "
+        "ON CONCAT(l.cluster, CAST(l.n AS STRING)) = r.key",
+        {"L": (left, {"cluster": "string", "n": "long"}),
+         "R": (right, {"key": "string", "v": "long"})},
+    )
+    assert sorted((r["n"], r["v"]) for r in rows) == [(1, 10), (2, 20)]
+
+
+def test_concat_cast_null_string_part_still_nulls_result():
+    """A NULL STRING part nulls the whole concat (no match); a zero
+    integer is the string '0', not null."""
+    cols = {"cluster": ["east", None], "n": [0, 1]}
+    tt = {"cluster": "string", "n": "long"}
+    rows, _, _ = run_sql(
+        "SELECT n FROM T WHERE CONCAT(cluster, CAST(n AS STRING)) = 'east0'",
+        {"T": (cols, tt)},
+    )
+    assert [r["n"] for r in rows] == [0]
+
+
+def test_concat_of_cast_double_still_rejected_with_clear_error():
+    cols = {"cluster": ["east"], "d": [1.5]}
+    tt = {"cluster": "string", "d": "double"}
+    with pytest.raises(EngineException, match="CAST of double"):
         run_sql(
-            "SELECT n FROM T WHERE CONCAT(cluster, CAST(n AS STRING)) = 'x'",
-            {"T": (T, TT)},
+            "SELECT d FROM T WHERE CONCAT(cluster, CAST(d AS STRING)) = 'x'",
+            {"T": (cols, tt)},
         )
 
 

@@ -81,6 +81,54 @@ def test_sender_to_ingestor_over_tcp():
         ing.close()
 
 
+def test_ingestor_survives_sender_drop_and_preserves_order():
+    """Consumer side of a sender drop: the per-connection reader exits
+    with its producer, the acceptor keeps serving, and a reconnecting
+    producer's points land after the first connection's (each
+    connection's ordered stream has one owner — the partition-lease
+    analog)."""
+    store = MetricStore()
+    ing = MetricsIngestor(store=store, port=0)
+    key = "DATAX-F:Input_Events_Count"
+    try:
+        s1 = MetricStreamSender("127.0.0.1", ing.port)
+        s1(key, 1000, 1)
+        s1(key, 2000, 2)
+        assert _wait(lambda: ing.metrics_sent == 2)
+        s1.close()  # sender drops mid-stream
+        s2 = MetricStreamSender("127.0.0.1", ing.port)
+        try:
+            s2(key, 3000, 3)
+            s2(key, 4000, 4)
+            assert _wait(lambda: ing.metrics_sent == 4)
+        finally:
+            s2.close()
+        pts = store.points(key)
+        assert [p["val"] for p in pts] == [1, 2, 3, 4]
+    finally:
+        ing.close()
+
+
+def test_sender_reconnects_once_on_broken_socket():
+    """The producer's one-retry reconnect (MetricStreamSender.__call__):
+    a dead socket surfaces as OSError on send; the point must arrive
+    over a fresh connection, in order after the earlier ones."""
+    store = MetricStore()
+    ing = MetricsIngestor(store=store, port=0)
+    key = "DATAX-F:Latency-Batch"
+    sender = MetricStreamSender("127.0.0.1", ing.port)
+    try:
+        sender(key, 1000, 1.0)
+        assert _wait(lambda: ing.metrics_sent == 1)
+        sender._sock.close()  # break the wire under the sender
+        sender(key, 2000, 2.0)
+        assert _wait(lambda: ing.metrics_sent == 2)
+        assert [p["val"] for p in store.points(key)] == [1.0, 2.0]
+    finally:
+        sender.close()
+        ing.close()
+
+
 def test_metric_logger_eventhub_conf_routes_to_ingestor():
     store = MetricStore()
     ing = MetricsIngestor(store=store, port=0)

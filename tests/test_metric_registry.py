@@ -1,0 +1,129 @@
+"""Tier-1 self-check: every metric name the engine emits at runtime is
+registered in constants.MetricName (RUNTIME_METRIC_PATTERNS), and the
+registry is documented in OBSERVABILITY.md — so a renamed/added metric
+cannot silently orphan a dashboard tile or the docs (the
+ANALYSIS.md-registry sync pattern from the analyzer PR)."""
+
+import json
+import os
+
+import pytest
+
+from data_accelerator_tpu.compile.codegen import CodegenEngine
+from data_accelerator_tpu.constants import MetricName
+from data_accelerator_tpu.core.config import SettingDictionary
+from data_accelerator_tpu.obs.metrics import MetricLogger
+from data_accelerator_tpu.obs.store import MetricStore
+from data_accelerator_tpu.runtime.host import StreamingHost
+
+INPUT_SCHEMA = json.dumps({
+    "type": "struct",
+    "fields": [
+        {"name": "deviceDetails", "type": {"type": "struct", "fields": [
+            {"name": "deviceId", "type": "long", "nullable": False,
+             "metadata": {"allowedValues": [1, 2, 3]}},
+            {"name": "deviceType", "type": "string", "nullable": False,
+             "metadata": {"allowedValues": ["DoorLock", "Heating"]}},
+            {"name": "status", "type": "long", "nullable": False,
+             "metadata": {"allowedValues": [0, 1]}},
+        ]}, "nullable": False, "metadata": {}},
+    ],
+})
+
+# aggregation + plain select so the run emits Output_* counts and the
+# GroupsDropped overflow slot; outputs go to a console sink (NOT the
+# metric sink — metric-table names are data, not registry members)
+QUERIES = (
+    "--DataXQuery--\n"
+    "DoorEvents = SELECT deviceDetails.deviceId, deviceDetails.status, "
+    "eventTimeStamp FROM DataXProcessedInput "
+    "WHERE deviceDetails.deviceType = 'DoorLock';\n"
+    "--DataXQuery--\n"
+    "DoorCounts = SELECT deviceId, COUNT(*) AS Cnt FROM DoorEvents "
+    "GROUP BY deviceId;\n"
+)
+
+
+@pytest.fixture
+def running_flow_store(tmp_path):
+    rc = CodegenEngine().generate_code(QUERIES, "[]", "registry")
+    transform_path = tmp_path / "flow.transform"
+    transform_path.write_text(rc.code)
+    conf = SettingDictionary({
+        "datax.job.name": "RegistryCheck",
+        "datax.job.input.default.inputtype": "local",
+        "datax.job.input.default.blobschemafile": INPUT_SCHEMA,
+        "datax.job.input.default.eventhub.maxrate": "40",
+        "datax.job.input.default.streaming.intervalinseconds": "1",
+        "datax.job.input.default.eventhub.checkpointdir": str(tmp_path / "ck"),
+        "datax.job.input.default.eventhub.checkpointinterval": "1 second",
+        "datax.job.process.timestampcolumn": "eventTimeStamp",
+        "datax.job.process.transform": str(transform_path),
+        "datax.job.process.projection": (
+            "current_timestamp() AS eventTimeStamp\nRaw.*"
+        ),
+        "datax.job.output.DoorEvents.console.maxrows": "1",
+        "datax.job.output.DoorCounts.console.maxrows": "1",
+    })
+    store = MetricStore()
+    host = StreamingHost(conf)
+    host.metric_logger = MetricLogger("DATAX-RegistryCheck", store=store)
+    from data_accelerator_tpu.runtime.sinks import (
+        OutputDispatcher,
+        build_output_operators,
+    )
+
+    host.dispatcher = OutputDispatcher(
+        build_output_operators(
+            conf, host.metric_logger,
+            {"DoorEvents": ["DoorEvents"], "DoorCounts": ["DoorCounts"]},
+        ),
+        host.metric_logger,
+    )
+    host.run(max_batches=2)
+    yield store
+    host.stop()
+
+
+def test_every_runtime_metric_is_registered(running_flow_store):
+    store = running_flow_store
+    keys = store.keys("DATAX-RegistryCheck:")
+    assert keys, "flow emitted no metrics"
+    unregistered = sorted(
+        k.partition(":")[2]
+        for k in keys
+        if not MetricName.is_runtime_metric(k.partition(":")[2])
+    )
+    assert not unregistered, (
+        f"unregistered runtime metric names {unregistered} — add them to "
+        "constants.MetricName.RUNTIME_METRIC_PATTERNS and document them "
+        "in OBSERVABILITY.md"
+    )
+    # the interesting families actually showed up (the check bites)
+    metrics = {k.partition(":")[2] for k in keys}
+    assert "Latency-Batch" in metrics
+    assert any(m.startswith("Latency-Decode-p") for m in metrics)
+    assert any(m.startswith("Input_") for m in metrics)
+    assert any(m.startswith("Output_") for m in metrics)
+    assert any(m.startswith("Sink_") for m in metrics)
+
+
+def test_stage_names_round_trip_to_registered_metrics():
+    for stage in MetricName.STAGES:
+        stem = MetricName.stage_metric(stage)
+        for q in (50, 95, 99):
+            assert MetricName.is_runtime_metric(f"{stem}-p{q}"), stage
+
+
+def test_registry_patterns_documented_in_observability_md():
+    doc = open(
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "OBSERVABILITY.md"),
+        encoding="utf-8",
+    ).read()
+    for pattern in MetricName.RUNTIME_METRIC_PATTERNS:
+        assert pattern in doc, (
+            f"registry pattern {pattern!r} missing from OBSERVABILITY.md"
+        )
+    for stage in MetricName.STAGES:
+        assert stage in doc, f"stage {stage!r} missing from OBSERVABILITY.md"

@@ -1,0 +1,279 @@
+"""Tests for the runtime observability layer: latency histograms, span
+tracing, JSONL flight-recorder rotation, the trace CLI, and the
+Prometheus/health exposition surface."""
+
+import io
+import json
+import os
+import re
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from data_accelerator_tpu.obs import telemetry, tracing
+from data_accelerator_tpu.obs.exposition import (
+    HealthState,
+    ObservabilityServer,
+    render_prometheus,
+)
+from data_accelerator_tpu.obs.histogram import HistogramRegistry, LatencyHistogram
+from data_accelerator_tpu.obs.store import MetricStore
+from data_accelerator_tpu.obs.tracing import Tracer
+
+
+class CaptureWriter(telemetry.TelemetryWriter):
+    def __init__(self):
+        self.records = []
+
+    def write(self, record):
+        self.records.append(record)
+
+
+# -- histograms ------------------------------------------------------------
+
+def test_histogram_buckets_and_counts():
+    h = LatencyHistogram(buckets_ms=(1, 10, 100))
+    for v in (0.5, 5, 50, 500):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 4
+    assert snap["cumulative"] == [1, 2, 3, 4]  # le=1, le=10, le=100, +Inf
+    assert snap["sum_ms"] == pytest.approx(555.5)
+
+
+def test_histogram_percentile_matches_numpy():
+    h = LatencyHistogram()
+    rng = np.random.RandomState(7)
+    samples = rng.lognormal(1.0, 1.0, 500)
+    for s in samples:
+        h.observe(s)
+    for q in (50, 95, 99):
+        assert h.percentile(q) == pytest.approx(
+            float(np.percentile(samples, q))
+        )
+
+
+def test_histogram_window_is_bounded():
+    h = LatencyHistogram(window=8)
+    for i in range(100):
+        h.observe(float(i))
+    # window holds the last 8 samples (92..99); count keeps the total
+    assert h.count == 100
+    assert h.percentile(0) >= 92.0
+
+
+def test_registry_keys_by_flow_and_stage():
+    r = HistogramRegistry()
+    r.observe("f1", "decode", 1.0)
+    r.observe("f1", "sync", 2.0)
+    r.observe("f2", "decode", 3.0)
+    assert r.stages("f1") == ["decode", "sync"]
+    assert r.percentile("f1", "decode", 50) == 1.0
+    assert r.percentile("f2", "missing", 50) is None
+
+
+# -- tracing ---------------------------------------------------------------
+
+def test_span_tree_and_histogram_feed():
+    w = CaptureWriter()
+    t = telemetry.TelemetryLogger("app", [w])
+    hist = HistogramRegistry()
+    tracer = Tracer(t, histograms=hist, flow="F")
+    ctx = tracer.begin("streaming/batch")
+    with ctx.activate():
+        with tracing.span("decode"):
+            with tracing.span("inner"):
+                pass
+        with tracing.span("dispatch"):
+            pass
+    ctx.end(batchTime=123)
+    spans = {r["name"]: r for r in w.records if r["type"] == "span"}
+    assert set(spans) == {"streaming/batch", "decode", "inner", "dispatch"}
+    root = spans["streaming/batch"]
+    assert root["parent"] is None
+    assert root["properties"]["batchTime"] == 123
+    assert spans["decode"]["parent"] == root["span"]
+    assert spans["inner"]["parent"] == spans["decode"]["span"]
+    # every span observed into its stage histogram; the root's
+    # "streaming/" prefix is stripped
+    assert set(hist.stages("F")) == {"batch", "decode", "inner", "dispatch"}
+
+
+def test_span_is_noop_without_active_trace():
+    with tracing.span("decode"):  # must not raise nor emit
+        pass
+    assert tracing.current_trace() is None
+
+
+def test_cross_thread_capture_and_record_since():
+    w = CaptureWriter()
+    tracer = Tracer(telemetry.TelemetryLogger("app", [w]))
+    ctx = tracer.begin()
+    ctx.mark("dispatch-done")
+    results = []
+
+    def worker(cap):
+        with tracing.activated(cap):
+            with tracing.span("sink/file"):
+                results.append(tracing.current_trace() is ctx)
+
+    with ctx.activate():
+        with tracing.span("sinks"):
+            cap = tracing.capture()
+            th = threading.Thread(target=worker, args=(cap,))
+            th.start()
+            th.join()
+    ctx.record_since("device-step", "dispatch-done")
+    ctx.end()
+    assert results == [True]
+    spans = {r["name"]: r for r in w.records if r["type"] == "span"}
+    # the worker's span parents under the "sinks" span, not the root
+    assert spans["sink/file"]["parent"] == spans["sinks"]["span"]
+    assert spans["device-step"]["durationMs"] >= 0
+
+
+def test_disabled_tracer_still_feeds_histograms():
+    w = CaptureWriter()
+    hist = HistogramRegistry()
+    tracer = Tracer(
+        telemetry.TelemetryLogger("app", [w]), histograms=hist,
+        flow="F", enabled=False,
+    )
+    ctx = tracer.begin()
+    with ctx.span("decode"):
+        pass
+    ctx.end()
+    assert not [r for r in w.records if r["type"] == "span"]
+    assert hist.stages("F") == ["batch", "decode"]
+
+
+# -- JSONL rotation --------------------------------------------------------
+
+def test_jsonl_writer_rotates_at_cap(tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    w = telemetry.JsonlWriter(p, max_bytes=400)
+    t = telemetry.TelemetryLogger("app", [w])
+    for i in range(40):
+        t.track_event("e", {"i": i})
+    assert os.path.exists(p + ".1")
+    assert os.path.getsize(p) <= 400
+    assert os.path.getsize(p + ".1") <= 400
+    # both files still parse line-by-line; records were never split
+    recs = []
+    for path in (p + ".1", p):
+        recs += [json.loads(ln) for ln in open(path).read().splitlines()]
+    assert all(r["name"] == "e" for r in recs)
+    # the most recent records survive rotation
+    assert recs[-1]["properties"]["i"] == 39
+
+
+# -- trace CLI -------------------------------------------------------------
+
+def test_trace_cli_reconstructs_span_tree(tmp_path, capsys):
+    from data_accelerator_tpu.obs.__main__ import main as obs_main
+
+    p = str(tmp_path / "t.jsonl")
+    t = telemetry.TelemetryLogger("app", [telemetry.JsonlWriter(p)])
+    tracer = Tracer(t)
+    ctx = tracer.begin("streaming/batch")
+    with ctx.activate():
+        with tracing.span("decode"):
+            pass
+        with tracing.span("collect"):
+            with tracing.span("materialize"):
+                pass
+    ctx.end(batchTime=1700000000123)
+
+    rc = obs_main(["trace", "1700000000123", "--file", p])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "streaming/batch" in out
+    assert "├─ decode" in out
+    assert "└─ materialize" in out
+    # trace-id lookup works too
+    assert obs_main(["trace", ctx.trace_id, "--file", p]) == 0
+    # unknown batch id fails with the known ids listed
+    assert obs_main(["trace", "999", "--file", p]) == 1
+    assert "1700000000123" in capsys.readouterr().err
+
+
+# -- Prometheus rendering --------------------------------------------------
+
+PROM_LINE = re.compile(
+    r"^(#.*|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [-+]?[0-9.eE+]+)$"
+)
+
+
+def test_render_prometheus_is_valid_text_format():
+    hist = HistogramRegistry(buckets_ms=(1, 10))
+    hist.observe("My Flow", "decode", 0.5)
+    hist.observe("My Flow", "decode", 5.0)
+    store = MetricStore()
+    store.add_point('DATAX-F:Input_Events_Count', 1000, 7)
+    store.zadd("DATAX-F:Alert", 1000.0, json.dumps({"Pivot1": "x"}))
+    health = HealthState(flow="My Flow")
+    health.record_batch(123, ok=True, latency_ms=5.0)
+    text = render_prometheus(hist, store, health)
+    for line in text.strip().splitlines():
+        assert PROM_LINE.match(line), line
+    assert 'datax_stage_latency_ms_bucket{flow="My Flow",stage="decode",le="1"} 1' in text
+    assert 'datax_stage_latency_ms_bucket{flow="My Flow",stage="decode",le="+Inf"} 2' in text
+    assert 'datax_stage_latency_ms_count{flow="My Flow",stage="decode"} 2' in text
+    assert 'datax_metric_last_value{app="DATAX-F",metric="Input_Events_Count"} 7' in text
+    # detail-event members (JSON rows) are not gauges and must be skipped
+    assert "Alert" not in text
+    assert 'datax_batches_processed_total{flow="My Flow"} 1' in text
+
+
+# -- health/readiness ------------------------------------------------------
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10
+        ) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_observability_server_probes():
+    health = HealthState(flow="F", batch_interval_s=1.0)
+    srv = ObservabilityServer(health, HistogramRegistry(), MetricStore(), port=0)
+    srv.start()
+    try:
+        status, body = _get(srv.port, "/healthz")
+        assert status == 200 and body["status"] == "ok"
+        # not ready before the first batch
+        status, body = _get(srv.port, "/readyz")
+        assert status == 503 and "no batch processed yet" in body["reasons"]
+        health.record_batch(1000, ok=True, latency_ms=4.2)
+        status, body = _get(srv.port, "/readyz")
+        assert status == 200 and body["ready"]
+        # a failed batch flips readiness off and healthz to degraded
+        health.record_batch(2000, ok=False, error="boom")
+        status, body = _get(srv.port, "/readyz")
+        assert status == 503 and any("boom" in r for r in body["reasons"])
+        status, body = _get(srv.port, "/healthz")
+        assert status == 200 and body["status"] == "degraded"
+        # /metrics serves the Prometheus content type
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=10
+        ) as r:
+            assert r.status == 200
+            assert "text/plain" in r.headers.get("Content-Type", "")
+    finally:
+        srv.stop()
+
+
+def test_checkpoint_staleness_gates_readiness():
+    health = HealthState(flow="F", checkpoint_interval_s=0.01)
+    health.record_batch(1000, ok=True)
+    health.record_checkpoint()
+    import time as _time
+
+    _time.sleep(0.05)  # > 3x the 10ms interval
+    reasons = health.readiness()
+    assert any("checkpoint stale" in r for r in reasons)

@@ -90,6 +90,21 @@ def test_metric_history_and_keys(web):
     assert json.loads(body) == ["DATAX-F:Input"]
 
 
+def test_prometheus_and_probe_endpoints(web):
+    srv, store = web
+    store.add_point("DATAX-F:Input_Events_Count", 1000, 5)
+    status, ctype, body = _get(srv, "/metrics")
+    assert status == 200 and "text/plain" in ctype
+    assert (
+        b'datax_metric_last_value{app="DATAX-F",'
+        b'metric="Input_Events_Count"} 5' in body
+    )
+    status, _, body = _get(srv, "/healthz")
+    assert status == 200 and json.loads(body)["status"] == "ok"
+    status, _, body = _get(srv, "/readyz")
+    assert status == 200 and json.loads(body)["ready"] is True
+
+
 def test_composition_page_registry(web):
     srv, _ = web
     status, _, body = _get(srv, "/composition")
