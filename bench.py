@@ -200,6 +200,45 @@ def measure_sync_rtt(proc, payload, base_ms, iters=8):
     return float(np.median(ts))
 
 
+def bench_context(dec_rows_s):
+    """Host-environment context so cross-round numbers are
+    self-describing (VERDICT Weak #7: contended hosts slow the decoder
+    >2x; loadavg + decoder rate at run time tell the reader whether a
+    swing is code or weather)."""
+    try:
+        load1, load5, _ = os.getloadavg()
+    except OSError:
+        load1 = load5 = None
+    return {
+        "loadavg_1m": round(load1, 2) if load1 is not None else None,
+        "loadavg_5m": round(load5, 2) if load5 is not None else None,
+        "cpu_count": os.cpu_count(),
+        "decoder_rows_per_sec": round(dec_rows_s, 1) if dec_rows_s else None,
+    }
+
+
+def hbm_model_check(proc):
+    """Cross-validate the static cost model against the production
+    lowering (analysis/deviceplan.py): closed-form predicted bytes vs
+    the shapes jax.eval_shape derives from the compiled plan — pure
+    abstract interpretation, no device execution. Recording both every
+    round means the model can never silently drift from the plan this
+    bench actually runs."""
+    from data_accelerator_tpu.analysis import analyze_processor
+
+    report = analyze_processor(proc, chips=16)
+    lowered = sum(s.hbm_bytes for s in report.stages)
+    predicted = sum(s.model_bytes for s in report.stages)
+    err = abs(predicted - lowered) / max(lowered, 1)
+    return {
+        "predicted_hbm_bytes": predicted,
+        "lowered_hbm_bytes": lowered,
+        "hbm_model_error": round(err, 4),
+        "ici_bytes_per_batch_16chip": report.totals()["iciBytesPerBatch"],
+        "stages": len(report.stages),
+    }
+
+
 def measure_device_step(proc, payloads, base_ms, sync_rtt_ms, k=16):
     """Per-batch device compute, amortized: enqueue K steps back-to-back
     and sync ONCE, so the tunnel round trip is paid once for K batches
@@ -315,6 +354,8 @@ def main():
         "decoder_mb_per_sec": round(dec_mb_s, 1) if dec_mb_s else None,
         "backend": backend,
         "batch_capacity": capacity,
+        "bench_context": bench_context(dec_rows_s),
+        "hbm_model": hbm_model_check(proc),
     }))
 
 

@@ -4,8 +4,17 @@ Design-time counterpart to the runtime compiler — reuses the production
 codegen + parsers so a bad flow config fails in milliseconds with a
 ``DXnnn``-coded diagnostic instead of minutes into a deployed job.
 
-CLI: ``python -m data_accelerator_tpu.analysis flow.json [--json]``
-(non-zero exit on error-severity diagnostics).
+Two tiers:
+
+- the semantic tier (``analyze_flow``): reference resolution, type
+  propagation, legality, dead flow, device-compilation risk;
+- the device tier (``analyze_flow_device``): abstract interpretation of
+  the *compiled* plan — per-stage HBM/FLOP/ICI cost report plus the
+  DX2xx capacity/recompilation lints (``deviceplan.py``).
+
+CLI: ``python -m data_accelerator_tpu.analysis flow.json [--json]
+[--device [--chips N]]`` (non-zero exit on error-severity diagnostics,
+device tier included when requested).
 """
 
 from .analyzer import (
@@ -14,6 +23,14 @@ from .analyzer import (
     FlowContext,
     analyze_flow,
     analyze_script,
+)
+from .deviceplan import (
+    DEFAULT_CHIPS,
+    DevicePlanReport,
+    StageCost,
+    analyze_flow_device,
+    analyze_processor,
+    combined_report_dict,
 )
 from .diagnostics import (
     CODES,
@@ -29,7 +46,9 @@ from .typeprop import TableScope, schema_to_types
 __all__ = [
     "AnalysisReport",
     "CODES",
+    "DEFAULT_CHIPS",
     "DEFAULT_MAX_STATE_ROWS",
+    "DevicePlanReport",
     "Diagnostic",
     "FlowAnalyzer",
     "FlowContext",
@@ -37,8 +56,12 @@ __all__ = [
     "SEV_ERROR",
     "SEV_WARNING",
     "Span",
+    "StageCost",
     "TableScope",
     "analyze_flow",
+    "analyze_flow_device",
+    "analyze_processor",
     "analyze_script",
+    "combined_report_dict",
     "schema_to_types",
 ]

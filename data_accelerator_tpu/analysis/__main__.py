@@ -1,7 +1,7 @@
 """Flow static analyzer CLI.
 
     python -m data_accelerator_tpu.analysis flow.json [flow2.json ...]
-        [--json]
+        [--json] [--device] [--chips=N]
 
 Each argument is a flow config file: either a designer gui JSON or a
 full flow document (``{"gui": {...}}``). Prints one line per diagnostic
@@ -9,24 +9,89 @@ full flow document (``{"gui": {...}}``). Prints one line per diagnostic
 non-zero when any file has error-severity diagnostics — the CI
 self-lint contract.
 
+``--device`` additionally runs the device-plan tier
+(``analysis/deviceplan.py``): abstract interpretation of the compiled
+plan under ``JAX_PLATFORMS=cpu`` — no device execution — printing the
+per-stage HBM/FLOP/ICI cost report and the DX2xx lints. Exit codes
+cover the device tier identically: its error diagnostics fail the run
+the same way the semantic tier's do. ``--chips=N`` sets the chip count
+for the ICI model (default 16, the v5e-16 north-star slice).
+
 Exit codes: 0 clean (warnings allowed) · 1 errors found · 2 usage/IO.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
-from typing import List
+from typing import List, Optional
 
-from .analyzer import analyze_flow
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024.0 or unit == "GB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}GB"
+
+
+def _fmt_count(n: float) -> str:
+    for unit in ("", "k", "M", "G"):
+        if abs(n) < 1000.0 or unit == "G":
+            return f"{n:.1f}{unit}" if unit else f"{int(n)}"
+        n /= 1000.0
+    return f"{n:.1f}G"
+
+
+def _print_device_plan(path: str, device) -> None:
+    totals = device.totals()
+    print(
+        f"{path}: device plan ({device.chips} chips): "
+        f"{len(device.stages)} stage(s), "
+        f"HBM {_fmt_bytes(totals['hbmBytes'])} "
+        f"(persistent {_fmt_bytes(totals['persistentBytes'])}, "
+        f"per-batch {_fmt_bytes(totals['perBatchBytes'])}), "
+        f"~{_fmt_count(totals['flops'])} FLOP/batch, "
+        f"ICI {_fmt_bytes(totals['iciBytesPerBatch'])}/batch"
+    )
+    for s in device.stages:
+        line = (
+            f"{path}:   [{s.kind}] {s.name} rows={s.rows} "
+            f"hbm={_fmt_bytes(s.hbm_bytes)}"
+        )
+        if s.flops:
+            line += f" flops={_fmt_count(s.flops)}"
+        if s.ici_bytes:
+            line += f" ici={_fmt_bytes(s.ici_bytes)}"
+        if s.transient_bytes:
+            line += f" transient={_fmt_bytes(s.transient_bytes)}"
+        if s.detail:
+            line += f" ({s.detail})"
+        print(line)
 
 
 def main(argv: List[str]) -> int:
+    # the device tier must never touch an accelerator: force abstract
+    # eval on the CPU backend before any jax import
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
     as_json = "--json" in argv
+    device_tier = "--device" in argv
+    chips: Optional[int] = None
+    for a in argv:
+        if a.startswith("--chips="):
+            try:
+                chips = int(a.split("=", 1)[1])
+            except ValueError:
+                print(f"invalid --chips value: {a}", file=sys.stderr)
+                return 2
     paths = [a for a in argv if not a.startswith("--")]
     if not paths:
         print(__doc__.strip(), file=sys.stderr)
         return 2
+
+    from .analyzer import analyze_flow
+    from .deviceplan import analyze_flow_device, combined_report_dict
 
     any_errors = False
     json_out = []
@@ -38,14 +103,28 @@ def main(argv: List[str]) -> int:
             print(f"{path}: cannot read flow config: {e}", file=sys.stderr)
             return 2
         report = analyze_flow(flow)
+        device = analyze_flow_device(flow, chips=chips) if device_tier else None
         any_errors |= not report.ok
+        if device is not None:
+            any_errors |= not device.ok
         if as_json:
-            json_out.append({"file": path, **report.to_dict()})
+            if device is not None:
+                json_out.append(
+                    {"file": path, **combined_report_dict(report, device)}
+                )
+            else:
+                json_out.append({"file": path, **report.to_dict()})
         else:
-            for d in report.diagnostics:
+            diags = list(report.diagnostics) + (
+                list(device.diagnostics) if device is not None else []
+            )
+            for d in diags:
                 print(f"{path}: {d.render()}")
-            n_e, n_w = len(report.errors), len(report.warnings)
+            n_e = len([d for d in diags if d.is_error])
+            n_w = len(diags) - n_e
             print(f"{path}: {n_e} error(s), {n_w} warning(s)")
+            if device is not None and device.stages:
+                _print_device_plan(path, device)
     if as_json:
         print(json.dumps(json_out if len(json_out) > 1 else json_out[0],
                          indent=2))

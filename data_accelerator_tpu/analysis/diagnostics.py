@@ -121,6 +121,24 @@ CODES: Dict[str, tuple] = {
               "use a literal pattern/position (column-valued patterns have no device tier)"),
     "DX042": (SEV_ERROR, "string function over a computed string (CONCAT/CAST result) is unsupported on device",
               "apply the function to the inputs before concatenating"),
+    # -- pass 6: device plan (analysis/deviceplan.py, the --device tier:
+    #    abstract interpretation of the compiled plan's static shapes) --
+    "DX200": (SEV_WARNING, "declared group-key cardinality exceeds the static group capacity: groups beyond the bound drop",
+              "raise process.maxgroups above the key cardinality, or group by a lower-cardinality key"),
+    "DX201": (SEV_WARNING, "join output capacity is below the left input capacity: even one match per row overflows and rows drop",
+              "raise process.joincapacity to at least the left side's batch/window capacity"),
+    "DX202": (SEV_WARNING, "string dictionary capacity is below the declared/sampled key cardinality: over-capacity keys collapse to NULL",
+              "raise process.stringdictionary.maxsize above the distinct string-value count"),
+    "DX203": (SEV_WARNING, "non-equi join terms force the O(n*m) match matrix at window scale",
+              "add an equality conjunct carrying the selectivity, shrink the window, or bound the pair budget"),
+    "DX204": (SEV_WARNING, "recompilation hazard: refresh-capable UDF or unbounded dictionary growth re-traces the jitted step",
+              "bound the dictionary (process.stringdictionary.maxsize) and keep UDF refresh intervals coarse"),
+    "DX205": (SEV_WARNING, "window retention approaches the int32 ring-rebase horizon (~24.8 days of relative millis)",
+              "shorten the window/watermark well below a quarter of the 2^31 ms horizon"),
+    "DX290": (SEV_ERROR, "flow fails device lowering: the planner rejected a statement the runtime would also reject",
+              "fix the statement per the planner's message (it is the production compiler's own error)"),
+    "DX291": (SEV_WARNING, "device analysis unavailable: no concrete input schema or design-time-unloadable UDF",
+              "inline the input schema JSON and declare UDF modules importable on the control plane"),
 }
 
 # which pass each code family belongs to (for grouping/reporting)
@@ -130,6 +148,8 @@ PASS_NAMES = {
     "DX02": "aggregation/window legality",
     "DX03": "dead flow",
     "DX04": "device-compilation risk",
+    "DX20": "device plan",
+    "DX29": "device plan",
 }
 
 

@@ -616,6 +616,48 @@ class CodegenEngine:
                     "type": "DetailsList" if is_alert else "MultiLineChart",
                 }
             )
+        # standing engine-health alert tile: the string-dictionary
+        # overflow counter. Over-capacity keys collapse to NULL with
+        # only this metric as the tell (core/schema.py degradation
+        # semantics), so every generated dashboard carries it as an
+        # alert tile — any non-zero sample means GROUP BY/JOIN string
+        # keys are being lost.
+        overflow_metric = "Input_string_dictionary_overflow_Count"
+        sources.append(
+            {
+                "name": "DictionaryOverflow",
+                "input": {
+                    "type": "MetricApi",
+                    "pollingInterval": 60000,
+                    "metricKeys": [{
+                        "name": f"_FLOW_:{overflow_metric}",
+                        "displayName": "String dictionary overflow",
+                    }],
+                },
+                "output": {
+                    "type": "DirectTimeChart",
+                    "data": {"timechart": True, "current": True,
+                             "table": False},
+                    "chartTimeWindowInMs": 3600000,
+                    "alert": {
+                        "threshold": 0,
+                        "message": "string dictionary at capacity: new "
+                                   "keys collapse to NULL (raise "
+                                   "process.stringdictionary.maxsize)",
+                    },
+                },
+            }
+        )
+        widgets.append(
+            {
+                "name": "DictionaryOverflow",
+                "displayName": "String dictionary overflow",
+                "data": "DictionaryOverflow_timechart",
+                "position": "Alerts",
+                "type": "MultiLineChart",
+                "alertTile": True,
+            }
+        )
         return {
             "metrics": {
                 "sources": sources,

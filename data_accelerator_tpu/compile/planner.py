@@ -141,6 +141,47 @@ class _OrderKeyScope(Scope):
             return (_SRC_BINDING_PREFIX + b, col)
 
 
+# ---------------------------------------------------------------------------
+# Stage plan metadata: what the planner DECIDED, recorded at lowering
+# time for the device-plan analyzer (analysis/deviceplan.py). Shapes are
+# static, so every capacity/algorithm choice below is exact — the cost
+# model reads these instead of re-deriving (and possibly mis-deriving)
+# the lowering.
+# ---------------------------------------------------------------------------
+@dataclass(frozen=True)
+class JoinSite:
+    """One JOIN in a statement's FROM chain, as actually lowered."""
+
+    kind: str  # "INNER" | "LEFT"
+    right_table: str
+    left_rows: int  # static rows feeding the left side of this site
+    right_rows: int
+    out_rows: int  # shared statement join capacity
+    algorithm: str  # "sort-merge" | "match-matrix"
+    n_eq_keys: int  # compiled equality key pairs
+    has_residual: bool  # non-equi ON terms force the match matrix
+
+
+@dataclass(frozen=True)
+class StagePlan:
+    """Static execution shape of one compiled view."""
+
+    kind: str  # "project" | "group" | "union"
+    input_rows: int  # FROM-scope capacity feeding the select
+    output_rows: int  # final output capacity (post ORDER/LIMIT)
+    joins: Tuple[JoinSite, ...] = ()
+    grouped: bool = False
+    group_keys: int = 0
+    # column names the group keys read (for cardinality lints)
+    group_key_cols: Tuple[str, ...] = ()
+    n_aggregates: int = 0
+    groups_bound: int = 0  # static group capacity (0 when ungrouped)
+    distinct: bool = False
+    order_keys: int = 0
+    limit: Optional[int] = None
+    union_branches: int = 1
+
+
 @dataclass
 class CompiledView:
     name: str
@@ -156,6 +197,9 @@ class CompiledView:
     # on the materialized host rows instead — [(column, ascending)]
     host_order: Optional[List[Tuple[str, bool]]] = None
     host_limit: Optional[int] = None
+    # lowering decisions, for static cost analysis (None for views built
+    # outside the select compiler, e.g. raw inputs)
+    plan: Optional[StagePlan] = None
 
 
 # ---------------------------------------------------------------------------
@@ -336,6 +380,18 @@ class SelectCompiler:
         view = CompiledView(
             name, schema, capacity, run,
             select_values=compiled[0].select_values,
+            plan=StagePlan(
+                kind="union",
+                input_rows=sum(
+                    c.plan.input_rows if c.plan else c.capacity
+                    for c in compiled
+                ),
+                output_rows=capacity,
+                joins=tuple(
+                    s for c in compiled if c.plan for s in c.plan.joins
+                ),
+                union_branches=len(compiled),
+            ),
         )
         if order_by or limit is not None:
             view = self._apply_order_limit(view, order_by, limit)
@@ -347,7 +403,7 @@ class SelectCompiler:
             raise EngineException(f"SELECT without FROM not supported ({name})")
 
         # 1. FROM/JOIN scope
-        scope, build_scope, scope_capacity = self._compile_from(sel)
+        scope, build_scope, scope_capacity, join_sites = self._compile_from(sel)
 
         compiler = _AggCollector(scope, self.dictionary, self.udfs, aux=self.aux)
 
@@ -382,6 +438,7 @@ class SelectCompiler:
                 name, sel, scope, compiler, build_scope, scope_capacity,
                 where_fn, out_types, deferred, flat_outputs, out_values,
                 having_fn=having_c.fn if having_c is not None else None,
+                join_sites=join_sites,
             )
             view.select_values = out_values
             if sel.order_by or sel.limit is not None:
@@ -428,7 +485,14 @@ class SelectCompiler:
 
         schema = ViewSchema(out_types, deferred)
         view = CompiledView(
-            name, schema, scope_capacity, run, select_values=out_values
+            name, schema, scope_capacity, run, select_values=out_values,
+            plan=StagePlan(
+                kind="project",
+                input_rows=scope_capacity,
+                output_rows=scope_capacity,
+                joins=tuple(join_sites),
+                distinct=bool(sel.distinct),
+            ),
         )
         if sel.order_by or sel.limit is not None:
             # Spark rejects DISTINCT + ORDER BY on unselected columns
@@ -448,7 +512,7 @@ class SelectCompiler:
         return self.catalog[table]
 
     def _compile_from(self, sel: Select):
-        """Returns (scope, build_scope_fn, capacity).
+        """Returns (scope, build_scope_fn, capacity, join_sites).
 
         build_scope_fn(tables, base_s, now) -> (scopes dict, valid, shape)
         """
@@ -466,7 +530,7 @@ class SelectCompiler:
                 t = tables[b.name]
                 return {b.binding: t.cols}, t.valid, t.valid.shape
 
-            return scope, build, base_cap
+            return scope, build, base_cap, []
 
         # join chain: fold joins left-to-right into one merged table
         bindings = [(base.binding, base.name, base_schema)]
@@ -511,6 +575,25 @@ class SelectCompiler:
             eq_pairs, residual = self._split_on(j.on, lscope, rscope)
             join_plans.append((j, jb, eq_pairs, residual, list(left_bindings)))
             left_bindings.append(jb)
+
+        # record the lowering decisions per site (cost-model metadata):
+        # the left side of site 0 is the base table; every later site
+        # reads the previous site's capacity-bounded output
+        join_sites: List[JoinSite] = []
+        left_rows = base_cap
+        for j, jb, eq_pairs, residual, _lbs in join_plans:
+            join_sites.append(JoinSite(
+                kind=j.kind,
+                right_table=jb[1],
+                left_rows=left_rows,
+                right_rows=self.capacities[jb[1]],
+                out_rows=out_cap,
+                algorithm="match-matrix" if residual is not None
+                else "sort-merge",
+                n_eq_keys=len(eq_pairs),
+                has_residual=residual is not None,
+            ))
+            left_rows = out_cap
 
         def build(tables, base_s, now_rel_ms):
             # left side accumulates as a single merged col-dict keyed by
@@ -604,7 +687,7 @@ class SelectCompiler:
             scope_tables[b] = dict(sch.types)
             scope_deferred[b] = self._deferred_exprs(b, sch)
         scope = Scope(tables=scope_tables, deferred=scope_deferred)
-        return scope, build, out_cap
+        return scope, build, out_cap, join_sites
 
     def _join_capacity(self, sel: Select) -> int:
         if self.config.join_capacity is not None:
@@ -1083,16 +1166,23 @@ class SelectCompiler:
         capacity = view.capacity
         if limit is not None and keys and limit < capacity:
             capacity = limit
+        plan = view.plan
+        if plan is not None:
+            plan = replace(
+                plan, output_rows=capacity,
+                order_keys=len(keys), limit=limit,
+            )
         return CompiledView(
             view.name, view.schema, capacity, run,
             select_values=view.select_values,
+            plan=plan,
         )
 
     # -- grouped path ----------------------------------------------------
     def _compile_grouped(
         self, name, sel, scope, compiler, build_scope, scope_capacity,
         where_fn, out_types, deferred, flat_outputs, out_values,
-        having_fn=None,
+        having_fn=None, join_sites=(),
     ) -> CompiledView:
         # group keys: resolve against select aliases first, then scope
         alias_map = {}
@@ -1262,7 +1352,22 @@ class SelectCompiler:
             return TableData(cols, out_valid)
 
         schema = ViewSchema(out_types, deferred)
-        return CompiledView(name, schema, capacity, run)
+        return CompiledView(
+            name, schema, capacity, run,
+            plan=StagePlan(
+                kind="group",
+                input_rows=scope_capacity,
+                output_rows=capacity,
+                joins=tuple(join_sites),
+                grouped=True,
+                group_keys=len(key_compiled),
+                group_key_cols=tuple(sorted({
+                    c for k in key_compiled for (_b, c) in k.deps
+                })),
+                n_aggregates=len(agg_nodes) + len(udaf_nodes),
+                groups_bound=capacity,
+            ),
+        )
 
 
 def _null_tag(null_expr: CompiledExpr, tag: int) -> CompiledExpr:

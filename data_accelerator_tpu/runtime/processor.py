@@ -67,6 +67,42 @@ def schema_to_view(schema: Schema) -> ViewSchema:
     return ViewSchema({c.name: _CTYPE_TO_PLAN[c.ctype] for c in schema.columns})
 
 
+def default_projection(schema: Schema, timestamp_column: Optional[str]) -> str:
+    """The HomeAutomation normalization snippet shape
+    (gui.input.properties.normalizationSnippet) used when a source
+    declares no projection of its own."""
+    lines = ["Raw.*"]
+    if timestamp_column and not schema.has(timestamp_column):
+        lines.insert(0, f"current_timestamp() AS {timestamp_column}")
+    return "\n".join(lines)
+
+
+def projection_select(step_text: str, from_table: str):
+    """One projection step (selectExpr lines) -> parsed Select
+    (handler/ProjectionHandler.scala semantics)."""
+    items = [
+        ln.strip()
+        for ln in step_text.replace("\r", "").split("\n")
+        if ln.strip() and not ln.strip().startswith("--")
+    ]
+    return parse_select("SELECT " + ", ".join(items) + f" FROM {from_table}")
+
+
+def window_target(wname: str, targets: List[str]) -> str:
+    """Bind a window name to its projected table: the longest target
+    ``T`` such that the window is named ``T_<duration>``. A
+    single-source flow may name windows freely (they can only mean
+    its one table); multi-source flows must prefix-match or set the
+    window's ``table`` conf key."""
+    best = ""
+    for t in targets:
+        if wname.startswith(t + "_") and len(t) > len(best):
+            best = t
+    if best:
+        return best
+    return targets[0] if len(targets) == 1 else ""
+
+
 def _read_maybe_file(value: str) -> str:
     """Conf values may inline content or point at a file (the reference
     always loads from storage; one-box flows inline the schema JSON).
@@ -486,34 +522,13 @@ class FlowProcessor:
 
     @staticmethod
     def _window_target(wname: str, targets: List[str]) -> str:
-        """Bind a window name to its projected table: the longest target
-        ``T`` such that the window is named ``T_<duration>``. A
-        single-source flow may name windows freely (they can only mean
-        its one table); multi-source flows must prefix-match or set the
-        window's ``table`` conf key."""
-        best = ""
-        for t in targets:
-            if wname.startswith(t + "_") and len(t) > len(best):
-                best = t
-        if best:
-            return best
-        return targets[0] if len(targets) == 1 else ""
+        return window_target(wname, targets)
 
     def _default_projection(self, schema: Schema) -> str:
-        # the HomeAutomation normalization snippet shape
-        # (gui.input.properties.normalizationSnippet)
-        lines = ["Raw.*"]
-        if self.timestamp_column and not schema.has(self.timestamp_column):
-            lines.insert(0, f"current_timestamp() AS {self.timestamp_column}")
-        return "\n".join(lines)
+        return default_projection(schema, self.timestamp_column)
 
     def _projection_select(self, step_text: str, from_table: str):
-        items = [
-            ln.strip()
-            for ln in step_text.replace("\r", "").split("\n")
-            if ln.strip() and not ln.strip().startswith("--")
-        ]
-        return parse_select("SELECT " + ", ".join(items) + f" FROM {from_table}")
+        return projection_select(step_text, from_table)
 
     def _build_pipeline(self, output_datasets: Optional[List[str]]):
         pc = PipelineCompiler(

@@ -68,6 +68,15 @@ class FlowOperation:
 
         return analyze_flow(flow)
 
+    def validate_flow_device(self, flow: dict, chips=None):
+        """The device tier of ``flow/validate`` (``device: true``):
+        abstract interpretation of the compiled plan — per-stage
+        HBM/FLOP/ICI cost report plus the DX2xx capacity lints. Same
+        implementation as the CLI's ``--device``; no device executes."""
+        from ..analysis import analyze_flow_device
+
+        return analyze_flow_device(flow, chips=chips)
+
     def generate_configs(self, flow_name: str) -> GenerationResult:
         doc = self.design.get_by_name(flow_name)
         if doc is not None:

@@ -165,7 +165,11 @@ class DataXApi:
     def _flow_validate(self, body, query):
         """Static analysis; same diagnostics as the analysis CLI (shared
         ``analysis.analyze_flow`` implementation). Body: a flow config
-        (gui JSON / full doc), or ``{"flowName": ...}`` for a saved one."""
+        (gui JSON / full doc), or ``{"flowName": ...}`` for a saved one.
+        ``"device": true`` adds the device-plan tier (the CLI's
+        ``--device``): DX2xx lints merged into the diagnostics plus a
+        ``device`` cost report (per-stage HBM/FLOP/ICI); optional
+        ``"chips": N`` sets the ICI model's chip count."""
         flow = body.get("flow") or body.get("gui")
         if flow is None and (body.get("flowName") or body.get("name")) \
                 and not body.get("process") and not body.get("input"):
@@ -174,7 +178,16 @@ class DataXApi:
                 raise ApiError("flow not found", status=404)
         if flow is None:
             flow = body
-        return self.flow_ops.validate_flow(flow).to_dict()
+        report = self.flow_ops.validate_flow(flow)
+        if not body.get("device"):
+            return report.to_dict()
+        from ..analysis import combined_report_dict
+
+        chips = body.get("chips")
+        device = self.flow_ops.validate_flow_device(
+            flow, chips=int(chips) if chips else None
+        )
+        return combined_report_dict(report, device)
 
     def _flow_generate(self, body, query):
         res = self.flow_ops.generate_configs(self._flow_name(body, query))

@@ -44,6 +44,13 @@ def _json_type(value: Any) -> Tuple[str, Any]:
     return "null", None  # None -> type decided by other samples
 
 
+# distinct scalar values tracked per field before the set is declared
+# high-cardinality and dropped; surviving sets become ``allowedValues``
+# metadata — the sampled-cardinality surface the device-plan analyzer's
+# DX200/DX202 capacity lints and utils/datagen.py consume
+_MAX_SAMPLED_VALUES = 64
+
+
 @dataclass
 class _FieldAcc:
     """Accumulated evidence for one field across samples."""
@@ -53,6 +60,8 @@ class _FieldAcc:
     element: Optional["_FieldAcc"] = None
     seen: int = 0
     nullable: bool = False
+    values: set = field(default_factory=set)
+    values_overflow: bool = False
 
     def observe(self, value: Any) -> None:
         self.seen += 1
@@ -73,10 +82,30 @@ class _FieldAcc:
                 self.element.observe(item)
             self.type = "array" if self.type in ("null", "array") else "string"
             return
+        if t in ("string", "long", "boolean") and not self.values_overflow:
+            self.values.add(value)
+            if len(self.values) > _MAX_SAMPLED_VALUES:
+                self.values_overflow = True
+                self.values.clear()
         if self.type == "null":
             self.type = t
         elif self.type != t:
             self.type = _WIDEN.get((self.type, t), "string")
+
+    def sampled_metadata(self) -> dict:
+        """``allowedValues`` for a low-cardinality scalar field whose
+        samples all share the final type (a widened/mixed field has no
+        meaningful value set)."""
+        if self.values_overflow or not self.values:
+            return {}
+        homogeneous = {
+            "string": lambda v: isinstance(v, str),
+            "boolean": lambda v: isinstance(v, bool),
+            "long": lambda v: isinstance(v, int) and not isinstance(v, bool),
+        }.get(self.type)
+        if homogeneous is None or not all(map(homogeneous, self.values)):
+            return {}
+        return {"allowedValues": sorted(self.values)}
 
     def to_schema_type(self) -> Any:
         if self.type == "struct" and self.struct is not None:
@@ -108,7 +137,7 @@ class _StructAcc:
                 "name": name,
                 "type": acc.to_schema_type(),
                 "nullable": acc.nullable or acc.seen < self.samples,
-                "metadata": {},
+                "metadata": acc.sampled_metadata(),
             })
         return {"type": "struct", "fields": out}
 

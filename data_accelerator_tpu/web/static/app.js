@@ -130,8 +130,37 @@ route("#/flow/", async (view, hash) => {
     toast("flow saved");
   };
   // inline diagnostics from the flow static analyzer (flow/validate —
-  // same DXnnn diagnostics as `python -m data_accelerator_tpu.analysis`)
+  // same DXnnn diagnostics as `python -m data_accelerator_tpu.analysis`,
+  // device tier included: the DX2xx lints + per-stage cost table)
   const diagBox = h("div", { class: "diags" });
+  const fmtBytes = (n) => {
+    for (const u of ["B", "KB", "MB", "GB"]) {
+      if (Math.abs(n) < 1024 || u === "GB")
+        return (u === "B" ? Math.round(n) : n.toFixed(1)) + u;
+      n /= 1024;
+    }
+  };
+  const renderCostTable = (dev) => {
+    if (!dev || !dev.stages || !dev.stages.length) return null;
+    const t = dev.totals || {};
+    return h("div", { class: "cost" },
+      h("div", { class: "muted" },
+        `device plan @ ${dev.chips} chips — HBM ${fmtBytes(t.hbmBytes || 0)}` +
+        ` (persistent ${fmtBytes(t.persistentBytes || 0)}),` +
+        ` ICI ${fmtBytes(t.iciBytesPerBatch || 0)}/batch,` +
+        ` ~${fmtVal(t.flops || 0)} FLOP/batch`),
+      h("table", { class: "grid cost-table" },
+        h("thead", {}, h("tr", {},
+          h("th", {}, "stage"), h("th", {}, "kind"), h("th", {}, "rows"),
+          h("th", {}, "HBM"), h("th", {}, "FLOPs"), h("th", {}, "ICI/batch"))),
+        h("tbody", {}, dev.stages.map((s) => h("tr", {},
+          h("td", { class: "mono" }, s.name),
+          h("td", {}, s.kind),
+          h("td", { class: "num" }, fmtVal(s.rows)),
+          h("td", { class: "num" }, fmtBytes(s.hbmBytes)),
+          h("td", { class: "num" }, s.flops ? fmtVal(s.flops) : "–"),
+          h("td", { class: "num" }, s.iciBytes ? fmtBytes(s.iciBytes) : "–"))))));
+  };
   const renderDiags = (r) => {
     diagBox.replaceChildren(
       h("div", { class: "muted" },
@@ -141,11 +170,13 @@ route("#/flow/", async (view, hash) => {
         h("span", { class: "diag-code" }, d.code),
         d.table ? h("span", { class: "diag-table" }, d.table) : null,
         h("span", {}, d.message),
-        d.span && d.span.line ? h("span", { class: "muted" }, ` line ${d.span.line}`) : null)));
+        d.span && d.span.line ? h("span", { class: "muted" }, ` line ${d.span.line}`) : null)),
+      renderCostTable(r.device));
   };
   const validate = async () => {
     await save();
-    const r = await api("POST", "/api/flow/flow/validate", { flow: gui });
+    const r = await api("POST", "/api/flow/flow/validate",
+      { flow: gui, device: true });
     renderDiags(r);
     toast(r.ok ? "flow is clean" : `${r.errorCount} error(s) found`, r.ok);
     return r;

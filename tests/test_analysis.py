@@ -25,6 +25,7 @@ from data_accelerator_tpu.analysis import (
     SEV_ERROR,
     SEV_WARNING,
     analyze_flow,
+    analyze_flow_device,
 )
 from data_accelerator_tpu.serve.scenarios import shipped_flow_guis
 
@@ -83,8 +84,37 @@ def test_golden_diagnostic(fixture, code, severity, line):
     assert d.severity == CODES[code][0]  # registry is the source of truth
 
 
+# device tier (analyze_flow_device / --device): fixture, code, severity.
+# Spans are flow-level (line 0) — these findings concern the compiled
+# plan, not one source statement.
+DEVICE_GOLDEN = [
+    ("dx200_group_capacity", "DX200", SEV_WARNING),
+    ("dx201_join_capacity", "DX201", SEV_WARNING),
+    ("dx202_dictionary_capacity", "DX202", SEV_WARNING),
+    ("dx203_match_matrix_window", "DX203", SEV_WARNING),
+    ("dx204_retrace_hazard", "DX204", SEV_WARNING),
+    ("dx205_rebase_proximity", "DX205", SEV_WARNING),
+    ("dx290_device_lowering", "DX290", SEV_ERROR),
+    ("dx291_unloadable_udf", "DX291", SEV_WARNING),
+]
+
+
+@pytest.mark.parametrize("fixture,code,severity", DEVICE_GOLDEN,
+                         ids=[g[0] for g in DEVICE_GOLDEN])
+def test_golden_device_diagnostic(fixture, code, severity):
+    flow = load_flow(fixture)
+    # device-tier-only findings: the semantic tier stays clean on them
+    assert analyze_flow(flow).errors == []
+    report = analyze_flow_device(flow)
+    hits = [d for d in report.diagnostics if d.code == code]
+    assert hits, f"expected {code}, got {[d.code for d in report.diagnostics]}"
+    assert hits[0].severity == severity
+    assert hits[0].severity == CODES[code][0]
+    assert report.ok == (severity != SEV_ERROR)
+
+
 def test_every_registered_code_has_a_golden_fixture():
-    assert {g[1] for g in GOLDEN} == set(CODES)
+    assert {g[1] for g in GOLDEN} | {g[1] for g in DEVICE_GOLDEN} == set(CODES)
 
 
 def test_analysis_md_documents_every_code():
@@ -146,6 +176,30 @@ def test_self_lint_generation_sample_flow():
 
     report = analyze_flow(make_gui("SelfLint"))
     assert report.errors == [], [d.render() for d in report.errors]
+
+
+def test_device_self_lint_shipped_and_baseline_flows():
+    """Tier-1 gate for the device tier: every shipped scenario flow AND
+    every clean baseline-mirror fixture passes ``--device`` analysis
+    clean (no error diagnostics, a non-empty cost report, and the
+    closed-form byte model agreeing exactly with the shapes the
+    production lowering derives)."""
+    flows = [(g.get("name"), g) for g in shipped_flow_guis()]
+    for path in clean_flow_paths():
+        with open(path) as f:
+            flows.append((os.path.basename(path), json.load(f)))
+    assert len(flows) >= 6
+    for name, flow in flows:
+        report = analyze_flow_device(flow)
+        assert report.errors == [], (
+            f"{name}: {[d.render() for d in report.errors]}"
+        )
+        assert report.stages, f"{name}: no cost stages"
+        for s in report.stages:
+            assert s.hbm_bytes == s.model_bytes, (
+                f"{name}/{s.name}: model {s.model_bytes} != "
+                f"lowered {s.hbm_bytes}"
+            )
 
 
 # ---------------------------------------------------------------------------
@@ -229,6 +283,82 @@ def test_cli_json_mode_matches_validate_endpoint():
 def test_cli_usage_error_without_args():
     proc = _run_cli([])
     assert proc.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# CLI --device tier: exit codes cover it identically (0 clean incl.
+# warnings, 1 on device-tier errors)
+# ---------------------------------------------------------------------------
+def test_cli_device_zero_exit_on_clean_configs(tmp_path):
+    paths = clean_flow_paths()
+    for i, gui in enumerate(shipped_flow_guis()):
+        p = tmp_path / f"scenario{i}.json"
+        p.write_text(json.dumps(gui))
+        paths.append(str(p))
+    proc = _run_cli(["--device", *paths])
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "device plan" in proc.stdout  # the cost report rendered
+
+
+def test_cli_device_nonzero_on_lowering_error():
+    proc = _run_cli([
+        "--device",
+        os.path.join(FLOWS_DIR, "dx290_device_lowering.json"),
+    ])
+    assert proc.returncode == 1, proc.stdout
+    assert "DX290" in proc.stdout
+    # without --device the same flow exits clean: the finding is
+    # device-tier-only
+    proc2 = _run_cli([
+        os.path.join(FLOWS_DIR, "dx290_device_lowering.json"),
+    ])
+    assert proc2.returncode == 0, proc2.stdout
+
+
+def test_cli_device_warning_keeps_zero_exit():
+    proc = _run_cli([
+        "--device",
+        os.path.join(FLOWS_DIR, "dx203_match_matrix_window.json"),
+    ])
+    assert proc.returncode == 0, proc.stdout
+    assert "DX203" in proc.stdout
+
+
+def test_cli_device_json_matches_validate_endpoint():
+    """The REST ``device: true`` path and the CLI ``--device --json``
+    path share one implementation — identical diagnostics AND identical
+    cost stages for the same flow JSON."""
+    from test_serve_jobs import FakeJobClient
+
+    from data_accelerator_tpu.serve.flowservice import FlowOperation
+    from data_accelerator_tpu.serve.restapi import DataXApi
+    from data_accelerator_tpu.serve.storage import (
+        LocalDesignTimeStorage,
+        LocalRuntimeStorage,
+    )
+
+    path = os.path.join(FLOWS_DIR, "dx200_group_capacity.json")
+    proc = _run_cli(["--device", "--json", path])
+    assert proc.returncode == 0, proc.stderr  # DX200 is a warning
+    cli_report = json.loads(proc.stdout)
+    assert cli_report["device"]["stages"]
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        api = DataXApi(FlowOperation(
+            LocalDesignTimeStorage(os.path.join(td, "design")),
+            LocalRuntimeStorage(os.path.join(td, "runtime")),
+            job_client=FakeJobClient(),
+        ))
+        status, out = api.dispatch(
+            "POST", "api/flow/validate",
+            body={"flow": load_flow("dx200_group_capacity"), "device": True},
+        )
+    assert status == 200
+    assert out["result"]["diagnostics"] == cli_report["diagnostics"]
+    assert out["result"]["device"]["stages"] == cli_report["device"]["stages"]
+    assert out["result"]["device"]["totals"] == cli_report["device"]["totals"]
 
 
 # ---------------------------------------------------------------------------

@@ -189,8 +189,28 @@ class TestDesignerE2E:
         for marker in (
             '"functions"', "AggregateRule", "_S_pivots", "_S_aggs",
             '"scale"', '"schedule"', "azureFunction", "Additional sources",
+            "renderCostTable", "device: true",
         ):
             assert marker in js, marker
+
+    def test_spa_validate_returns_device_cost_report(self, stack):
+        """The Validate button's request (app.js: flow + device: true)
+        through the full website->gateway bridge returns merged
+        diagnostics plus the per-stage cost table the pane renders."""
+        web, *_ = stack
+        status, out = _call(web.port, "POST", "/api/flow/flow/validate",
+                            {"flow": make_gui("ValidateDev"),
+                             "device": True, "chips": 16})
+        assert status == 200, out
+        r = out["result"]
+        assert r["ok"], r["diagnostics"]
+        dev = r["device"]
+        assert dev["chips"] == 16  # request override beats jobconfig's 1
+        assert dev["stages"], r
+        kinds = {s["kind"] for s in dev["stages"]}
+        assert "input" in kinds and "group" in kinds
+        assert dev["totals"]["hbmBytes"] > 0
+        assert dev["totals"]["iciBytesPerBatch"] > 0
 
 
 WX_SCHEMA = json.dumps({"type": "struct", "fields": [
