@@ -4,17 +4,20 @@ Design-time counterpart to the runtime compiler — reuses the production
 codegen + parsers so a bad flow config fails in milliseconds with a
 ``DXnnn``-coded diagnostic instead of minutes into a deployed job.
 
-Two tiers:
+Three tiers:
 
 - the semantic tier (``analyze_flow``): reference resolution, type
   propagation, legality, dead flow, device-compilation risk;
 - the device tier (``analyze_flow_device``): abstract interpretation of
   the *compiled* plan — per-stage HBM/FLOP/ICI cost report plus the
-  DX2xx capacity/recompilation lints (``deviceplan.py``).
+  DX2xx capacity/recompilation lints (``deviceplan.py``);
+- the UDF tier (``analyze_flow_udfs``): taint-lattice abstract
+  interpretation of the flow's UDF device-function ASTs — the DX3xx
+  tracing-safety/purity/determinism lints (``udfcheck.py``).
 
 CLI: ``python -m data_accelerator_tpu.analysis flow.json [--json]
-[--device [--chips N]]`` (non-zero exit on error-severity diagnostics,
-device tier included when requested).
+[--device [--chips N]] [--udfs]`` (non-zero exit on error-severity
+diagnostics, optional tiers included when requested).
 """
 
 from .analyzer import (
@@ -42,6 +45,12 @@ from .diagnostics import (
     Span,
 )
 from .typeprop import TableScope, schema_to_types
+from .udfcheck import (
+    UdfCheckReport,
+    UdfSummary,
+    analyze_flow_udfs,
+    check_udf_object,
+)
 
 __all__ = [
     "AnalysisReport",
@@ -58,10 +67,14 @@ __all__ = [
     "Span",
     "StageCost",
     "TableScope",
+    "UdfCheckReport",
+    "UdfSummary",
     "analyze_flow",
     "analyze_flow_device",
+    "analyze_flow_udfs",
     "analyze_processor",
     "analyze_script",
+    "check_udf_object",
     "combined_report_dict",
     "schema_to_types",
 ]

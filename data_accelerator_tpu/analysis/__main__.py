@@ -1,7 +1,7 @@
 """Flow static analyzer CLI.
 
     python -m data_accelerator_tpu.analysis flow.json [flow2.json ...]
-        [--json] [--device] [--chips=N]
+        [--json] [--device] [--chips=N] [--udfs]
 
 Each argument is a flow config file: either a designer gui JSON or a
 full flow document (``{"gui": {...}}``). Prints one line per diagnostic
@@ -16,6 +16,12 @@ per-stage HBM/FLOP/ICI cost report and the DX2xx lints. Exit codes
 cover the device tier identically: its error diagnostics fail the run
 the same way the semantic tier's do. ``--chips=N`` sets the chip count
 for the ICI model (default 16, the v5e-16 north-star slice).
+
+``--udfs`` additionally runs the UDF tier (``analysis/udfcheck.py``):
+every declared UDF/UDAF resolves through the production loader and its
+device functions' ASTs are abstract-interpreted under a taint lattice,
+emitting the DX3xx tracing-safety/purity/determinism lints. Same exit
+contract.
 
 Exit codes: 0 clean (warnings allowed) · 1 errors found · 2 usage/IO.
 """
@@ -77,6 +83,7 @@ def main(argv: List[str]) -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     as_json = "--json" in argv
     device_tier = "--device" in argv
+    udf_tier = "--udfs" in argv
     chips: Optional[int] = None
     for a in argv:
         if a.startswith("--chips="):
@@ -92,6 +99,7 @@ def main(argv: List[str]) -> int:
 
     from .analyzer import analyze_flow
     from .deviceplan import analyze_flow_device, combined_report_dict
+    from .udfcheck import analyze_flow_udfs
 
     any_errors = False
     json_out = []
@@ -104,20 +112,24 @@ def main(argv: List[str]) -> int:
             return 2
         report = analyze_flow(flow)
         device = analyze_flow_device(flow, chips=chips) if device_tier else None
+        udfs = analyze_flow_udfs(flow) if udf_tier else None
         any_errors |= not report.ok
         if device is not None:
             any_errors |= not device.ok
+        if udfs is not None:
+            any_errors |= not udfs.ok
         if as_json:
-            if device is not None:
-                json_out.append(
-                    {"file": path, **combined_report_dict(report, device)}
-                )
+            if device is not None or udfs is not None:
+                json_out.append({
+                    "file": path,
+                    **combined_report_dict(report, device, udfs),
+                })
             else:
                 json_out.append({"file": path, **report.to_dict()})
         else:
             diags = list(report.diagnostics) + (
                 list(device.diagnostics) if device is not None else []
-            )
+            ) + (list(udfs.diagnostics) if udfs is not None else [])
             for d in diags:
                 print(f"{path}: {d.render()}")
             n_e = len([d for d in diags if d.is_error])
@@ -125,6 +137,14 @@ def main(argv: List[str]) -> int:
             print(f"{path}: {n_e} error(s), {n_w} warning(s)")
             if device is not None and device.stages:
                 _print_device_plan(path, device)
+            if udfs is not None and udfs.udfs:
+                for u in udfs.udfs:
+                    roles = ",".join(u.analyzed) or "none"
+                    print(
+                        f"{path}: udf {u.name} [{u.tier}] "
+                        f"{u.kind or 'unloadable'} ({u.path}) "
+                        f"analyzed={roles}"
+                    )
     if as_json:
         print(json.dumps(json_out if len(json_out) > 1 else json_out[0],
                          indent=2))

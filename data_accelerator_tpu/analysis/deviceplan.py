@@ -200,21 +200,32 @@ def _ordered(diags: List[Diagnostic]) -> List[Diagnostic]:
 
 
 def combined_report_dict(
-    base: AnalysisReport, device: DevicePlanReport
+    base: AnalysisReport, device: Optional[DevicePlanReport] = None,
+    udfs=None,
 ) -> dict:
-    """Merge the semantic tier and the device tier into one response:
-    a superset of ``AnalysisReport.to_dict()`` plus the ``device`` cost
-    report — what ``flow/validate`` returns with ``device: true`` and
-    what the CLI's ``--device --json`` prints."""
-    diags = _ordered(list(base.diagnostics) + list(device.diagnostics))
+    """Merge the semantic tier with the optional device and UDF tiers
+    into one response: a superset of ``AnalysisReport.to_dict()`` plus
+    a ``device`` cost report and/or a ``udfs`` summary — what
+    ``flow/validate`` returns with ``device: true`` / ``udfs: true``
+    and what the CLI's ``--device``/``--udfs`` ``--json`` prints."""
+    diags = list(base.diagnostics)
+    if device is not None:
+        diags += list(device.diagnostics)
+    if udfs is not None:
+        diags += list(udfs.diagnostics)
+    diags = _ordered(diags)
     errors = [d for d in diags if d.is_error]
-    return {
+    out = {
         "ok": not errors,
         "errorCount": len(errors),
         "warningCount": len(diags) - len(errors),
         "diagnostics": [d.to_dict() for d in diags],
-        "device": device.plan_dict(),
     }
+    if device is not None:
+        out["device"] = device.plan_dict()
+    if udfs is not None:
+        out["udfs"] = udfs.udfs_dict()
+    return out
 
 
 # ---------------------------------------------------------------------------

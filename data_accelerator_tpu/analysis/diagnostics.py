@@ -139,6 +139,23 @@ CODES: Dict[str, tuple] = {
               "fix the statement per the planner's message (it is the production compiler's own error)"),
     "DX291": (SEV_WARNING, "device analysis unavailable: no concrete input schema or design-time-unloadable UDF",
               "inline the input schema JSON and declare UDF modules importable on the control plane"),
+    # -- pass 7: UDF tracing-safety/purity/determinism (analysis/
+    #    udfcheck.py, the --udfs tier: taint-lattice abstract
+    #    interpretation of UDF device-function ASTs) -------------------
+    "DX300": (SEV_ERROR, "data-dependent Python control flow on a traced value: if/while/short-circuit bool on a tracer raises TracerBoolConversionError under jit",
+              "replace the branch with jnp.where/lax.select (or lax.cond) so control flow stays in the traced graph"),
+    "DX301": (SEV_ERROR, "host sync point on a traced value: .item()/.tolist()/float()/int()/np.asarray of a tracer fails to concretize under jit",
+              "keep the computation in jax.numpy; concretize only outside the jitted step"),
+    "DX302": (SEV_WARNING, "impure device function: mutates global/closure state, does I/O, or draws host randomness (time.*/random/np.random) — runs once at trace time, then never again",
+              "make the function pure; use jax.random with an explicit key, and move state behind on_interval"),
+    "DX303": (SEV_WARNING, "captured mutable state with no on_interval declared: the jitted step bakes the state in at trace time and silently serves stale values",
+              "declare on_interval so state changes re-trace the step (DynamicUDF.onInterval semantics), or capture immutable values"),
+    "DX304": (SEV_WARNING, "declared out_type disagrees with the return dtype inferred under the type lattice: results decode through the wrong column type",
+              "fix out_type (or the return expression) so the declared SQL type matches what the function computes"),
+    "DX305": (SEV_ERROR, "Pallas kernel hazard: grid/BlockSpec derived from traced values or pallas_call without out_shape cannot lower",
+              "derive grid/BlockSpec from static shapes only and always pass out_shape=jax.ShapeDtypeStruct(...)"),
+    "DX310": (SEV_ERROR, "UDF conf entry does not load: bad package.module:attr, non-callable target, or aggregate without reduce",
+              "point class/module at an importable UDF object or zero-arg factory; aggregates must provide reduce"),
 }
 
 # which pass each code family belongs to (for grouping/reporting)
@@ -150,6 +167,8 @@ PASS_NAMES = {
     "DX04": "device-compilation risk",
     "DX20": "device plan",
     "DX29": "device plan",
+    "DX30": "udf tracing safety",
+    "DX31": "udf tracing safety",
 }
 
 

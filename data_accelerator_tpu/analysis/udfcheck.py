@@ -1,0 +1,938 @@
+"""UDF static analyzer: tracing-safety, purity and determinism lints.
+
+Third analysis tier (the ``--udfs`` tier). Where ``analyzer.py`` checks
+what a flow *means* and ``deviceplan.py`` what its compiled plan will
+*cost*, this tier checks what the flow's user code *does*: it resolves
+every declared UDF/UDAF through the production loader
+(``udf/api.py:load_udfs_from_conf`` — the same reflection path the
+runtime jits blind) and abstract-interprets the device functions'
+Python ASTs (``inspect.getsource`` + ``ast``) under a two-point taint
+lattice: a traced argument is TRACED, anything derived from a traced
+value stays TRACED, everything else is HOST. The DX3xx family falls
+out of where TRACED values flow:
+
+- **DX300** — TRACED value in a Python control-flow position
+  (``if``/``while``/``assert``/short-circuit ``and``/``or``/
+  ``range()``): the tracer cannot be collapsed to a Python bool, so
+  the deployed job dies with ``TracerBoolConversionError``.
+- **DX301** — host sync point (``.item()``, ``.tolist()``,
+  ``float()``/``int()``, ``np.asarray``) on a TRACED value:
+  ``ConcretizationTypeError`` under ``jax.jit``.
+- **DX302** — impurity: mutating global/closure state, I/O,
+  ``time.*`` or host randomness (``random``/``np.random`` instead of
+  ``jax.random``). Runs ONCE at trace time, then never again — the
+  documented "pure and traceable" contract in ``udf/api.py``.
+- **DX303** — captured mutable state with no ``on_interval``
+  declared: the jitted step bakes the state in at trace time and
+  silently serves stale values (the reference's
+  ``DynamicUDF.onInterval`` gap).
+- **DX304** — declared ``out_type`` inconsistent with the return
+  dtype inferred under a small dtype lattice (float/int/bool).
+- **DX305** — Pallas kernel hazards: ``pallas_call`` without
+  ``out_shape``, or ``grid``/``BlockSpec``/``out_shape`` derived from
+  TRACED values.
+- **DX310** — the conf entry itself does not load: bad
+  ``package.module:attr``, non-callable target, aggregate without
+  ``reduce``, duplicate declaration.
+
+Verdicts are ground-truthed, not pattern-matched: for every code,
+``tests/test_udfcheck.py`` pairs the golden-fixture analyzer test with
+a runtime test asserting the flagged UDF really does raise / retrace /
+desync under ``jax.jit`` while its clean twin traces exactly once —
+the analyzer cannot drift from what the tracer actually rejects.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..core.config import EngineException, SettingDictionary
+from .diagnostics import Diagnostic, Span, make
+
+# attribute reads that stay static under tracing (safe to branch on)
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "itemsize", "weak_type"}
+
+# method calls that force a device->host sync on a traced receiver
+_SYNC_METHODS = {"item", "tolist", "block_until_ready", "copy_to_host"}
+
+# builtins that concretize (DX301) or bool-convert (DX300) a tracer
+_HOST_CASTS = {"float", "int", "complex"}
+_BOOL_BUILTINS = {"bool", "any", "all", "max", "min", "sorted", "range"}
+
+# container-mutating method names (on a captured object -> impurity)
+_MUTATORS = {
+    "append", "extend", "insert", "add", "update", "pop", "popitem",
+    "remove", "discard", "clear", "setdefault", "write", "writelines",
+}
+
+# plain-name calls that do I/O at trace time
+_IO_CALLS = {"open", "print", "input"}
+
+# dotted-call prefixes that make a device function nondeterministic or
+# wall-clock dependent (jax.random is the sanctioned alternative)
+_NONDET_PREFIXES = (
+    "time.", "random.", "np.random.", "numpy.random.", "secrets.",
+    "uuid.", "os.urandom", "datetime.",
+)
+
+# numpy conversion entry points that concretize a tracer
+_NP_CONVERTERS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "np.copy", "numpy.copy", "np.float32", "np.float64", "np.int32",
+    "np.int64",
+}
+
+# declared SQL out_type -> dtype-lattice point
+_DECLARED_DTYPE = {
+    "double": "float", "float": "float",
+    "long": "int", "int": "int", "integer": "int", "bigint": "int",
+    "boolean": "bool", "bool": "bool",
+}
+
+# jnp/np function name -> result lattice point (by final attr segment)
+_FLOAT_FNS = {
+    "exp", "expm1", "log", "log1p", "log2", "log10", "sqrt", "cbrt",
+    "sin", "cos", "tan", "tanh", "sinh", "cosh", "arcsin", "arccos",
+    "arctan", "arctan2", "power", "sigmoid", "softmax", "logaddexp",
+    "mean", "var", "std", "linspace",
+}
+_BOOL_FNS = {
+    "isfinite", "isnan", "isinf", "isclose", "logical_and",
+    "logical_or", "logical_not", "logical_xor", "equal", "not_equal",
+    "greater", "less", "greater_equal", "less_equal",
+}
+_DTYPE_NAMES = {
+    "float16": "float", "bfloat16": "float", "float32": "float",
+    "float64": "float", "float_": "float",
+    "int8": "int", "int16": "int", "int32": "int", "int64": "int",
+    "uint8": "int", "uint16": "int", "uint32": "int", "uint64": "int",
+    "int_": "int", "bool_": "bool", "bool": "bool",
+}
+
+
+def _dotted(node: ast.AST) -> str:
+    """``pl.pallas_call`` -> "pl.pallas_call"; "" when not a plain
+    dotted name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+# ---------------------------------------------------------------------------
+# Source resolution: callable -> AST node (+ absolute line numbers)
+# ---------------------------------------------------------------------------
+def _fn_node(fn) -> Optional[ast.AST]:
+    """AST of a function/lambda's definition, or None when source is
+    unavailable (C functions, exec'd code). Prefers parsing the whole
+    defining file and locating the node by line number — that handles
+    lambdas embedded mid-expression and keeps ``Span.line`` pointing at
+    real module lines."""
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return None
+    tree = None
+    try:
+        lines, _ = inspect.findsource(code)
+        tree = ast.parse("".join(lines))
+    except (OSError, TypeError, SyntaxError):
+        tree = None
+    if tree is not None:
+        want = code.co_firstlineno
+        best = None
+        for n in ast.walk(tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if n.name != code.co_name:
+                    continue
+            elif isinstance(n, ast.Lambda):
+                if code.co_name != "<lambda>":
+                    continue
+            else:
+                continue
+            if n.lineno > want or (n.end_lineno or n.lineno) < want:
+                continue
+            if best is None or n.lineno > best.lineno:
+                best = n
+        if best is not None:
+            return best
+    # fallback: the function's own source block (dynamically defined
+    # functions pytest writes to temp files, doctests, ...)
+    try:
+        src = textwrap.dedent(inspect.getsource(fn)).strip().rstrip(",")
+    except (OSError, TypeError):
+        return None
+    try:
+        mod = ast.parse(src)
+    except SyntaxError:
+        return None
+    for n in ast.walk(mod):
+        if isinstance(n, (ast.FunctionDef, ast.Lambda)):
+            return n
+    return None
+
+
+def _param_names(node: ast.AST) -> List[str]:
+    a = node.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return [n for n in names if n != "self"]
+
+
+def _local_names(node: ast.AST) -> set:
+    """Every name the function binds locally (params + assignment
+    targets) — writes to anything else mutate captured state."""
+    out = set(_param_names(node))
+    body = node.body if isinstance(node.body, list) else []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            out.add(n.id)
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and n is not node:
+            out.add(n.name)
+        elif isinstance(n, ast.comprehension):
+            for t in ast.walk(n.target):
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif isinstance(n, (ast.Import, ast.ImportFrom)):
+            for alias in n.names:
+                out.add((alias.asname or alias.name).split(".")[0])
+    del body
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The per-function abstract interpreter
+# ---------------------------------------------------------------------------
+class _FnLinter:
+    """One device function's taint walk. ``tainted`` holds names bound
+    to traced values; findings dedupe on (code, line, message) so loop
+    bodies can be walked twice for a cheap taint fixpoint."""
+
+    def __init__(self, node: ast.AST, udf_name: str, role: str,
+                 untraced_params: Sequence[str] = ()):
+        self.node = node
+        self.udf = udf_name
+        self.role = role
+        self.tainted = {
+            p for p in _param_names(node) if p not in untraced_params
+        }
+        self.locals = _local_names(node)
+        self.escaping: set = set()  # global/nonlocal declarations
+        self.dtypes: Dict[str, Optional[str]] = {}
+        self.return_dtypes: List[Optional[str]] = []
+        self._found: set = set()
+        self.diags: List[Diagnostic] = []
+
+    # -- reporting -------------------------------------------------------
+    def _emit(self, code: str, node: ast.AST, message: str) -> None:
+        key = (code, getattr(node, "lineno", 0), message)
+        if key in self._found:
+            return
+        self._found.add(key)
+        self.diags.append(make(
+            code, self.udf, f"{self.role}: {message}",
+            Span(line=getattr(node, "lineno", 0)),
+        ))
+
+    # -- entry -----------------------------------------------------------
+    def run(self) -> "_FnLinter":
+        if isinstance(self.node, ast.Lambda):
+            dt = self._expr(self.node.body)
+            self.return_dtypes.append(dt)
+        else:
+            self._stmts(self.node.body)
+            # second pass settles taint that loops feed back
+            self._stmts(self.node.body)
+        return self
+
+    # -- statements ------------------------------------------------------
+    def _stmts(self, body: List[ast.stmt]) -> None:
+        for s in body:
+            self._stmt(s)
+
+    def _stmt(self, s: ast.stmt) -> None:
+        if isinstance(s, (ast.Global, ast.Nonlocal)):
+            self.escaping.update(s.names)
+        elif isinstance(s, ast.Assign):
+            dt = self._expr(s.value)
+            taint = self._taint(s.value)
+            for t in s.targets:
+                self._assign_target(t, taint, dt, s)
+        elif isinstance(s, ast.AnnAssign) and s.value is not None:
+            dt = self._expr(s.value)
+            self._assign_target(s.target, self._taint(s.value), dt, s)
+        elif isinstance(s, ast.AugAssign):
+            self._expr(s.value)
+            taint = self._taint(s.value) or self._taint(s.target)
+            self._assign_target(s.target, taint, None, s)
+        elif isinstance(s, ast.If):
+            self._expr(s.test)
+            if self._taint(s.test):
+                self._emit(
+                    "DX300", s,
+                    "`if` on a traced value — the tracer cannot become a "
+                    "Python bool (TracerBoolConversionError at runtime); "
+                    "use jnp.where/lax.select",
+                )
+            self._stmts(s.body)
+            self._stmts(s.orelse)
+        elif isinstance(s, ast.While):
+            self._expr(s.test)
+            if self._taint(s.test):
+                self._emit(
+                    "DX300", s,
+                    "`while` on a traced value — data-dependent loop "
+                    "bounds cannot trace; use lax.while_loop",
+                )
+            self._stmts(s.body)
+            self._stmts(s.orelse)
+        elif isinstance(s, ast.For):
+            self._expr(s.iter)
+            taint = self._taint(s.iter)
+            self._assign_target(s.target, taint, None, s)
+            self._stmts(s.body)
+            self._stmts(s.orelse)
+        elif isinstance(s, ast.Assert):
+            self._expr(s.test)
+            if self._taint(s.test):
+                self._emit(
+                    "DX300", s,
+                    "`assert` on a traced value bool-converts the tracer; "
+                    "use checkify or drop the assert",
+                )
+        elif isinstance(s, ast.Return):
+            if s.value is not None:
+                self.return_dtypes.append(self._expr(s.value))
+                # taint handled by _expr side effects
+        elif isinstance(s, ast.Expr):
+            self._expr(s.value)
+        elif isinstance(s, (ast.With,)):
+            for item in s.items:
+                self._expr(item.context_expr)
+            self._stmts(s.body)
+        elif isinstance(s, ast.Try):
+            self._stmts(s.body)
+            for h in s.handlers:
+                self._stmts(h.body)
+            self._stmts(s.orelse)
+            self._stmts(s.finalbody)
+        elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            pass  # nested defs are traced when called; out of scope
+        # Import/Pass/etc: nothing to do
+
+    def _assign_target(self, t: ast.expr, taint: bool,
+                       dt: Optional[str], stmt: ast.stmt) -> None:
+        if isinstance(t, ast.Name):
+            if t.id in self.escaping:
+                self._emit(
+                    "DX302", stmt,
+                    f"writes global/nonlocal '{t.id}' — the write runs "
+                    "once at trace time, then never again under jit",
+                )
+            if taint:
+                self.tainted.add(t.id)
+            else:
+                self.tainted.discard(t.id)
+            self.dtypes[t.id] = dt
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                self._assign_target(el, taint, None, stmt)
+        elif isinstance(t, (ast.Subscript, ast.Attribute)):
+            base = t.value
+            while isinstance(base, (ast.Subscript, ast.Attribute)):
+                base = base.value
+            if isinstance(base, ast.Name) and base.id not in self.locals:
+                self._emit(
+                    "DX302", stmt,
+                    f"mutates captured object '{base.id}' — state writes "
+                    "happen at trace time only; pure functions + "
+                    "on_interval refresh is the supported pattern",
+                )
+            if isinstance(t, ast.Subscript):
+                self._expr(t.slice)
+
+    # -- expressions: returns the inferred dtype lattice point ----------
+    def _taint(self, e: ast.expr) -> bool:
+        """Is this expression derived from a traced value? (Pure
+        query — no diagnostics; ``_expr`` must already have walked it.)"""
+        if isinstance(e, ast.Name):
+            return e.id in self.tainted
+        if isinstance(e, ast.Constant):
+            return False
+        if isinstance(e, ast.Attribute):
+            if e.attr in _STATIC_ATTRS:
+                return False
+            return self._taint(e.value)
+        if isinstance(e, ast.Subscript):
+            return self._taint(e.value) or self._taint(e.slice)
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._taint(x) for x in e.elts)
+        if isinstance(e, ast.Dict):
+            return any(
+                self._taint(x) for x in (*e.keys, *e.values) if x is not None
+            )
+        if isinstance(e, ast.BinOp):
+            return self._taint(e.left) or self._taint(e.right)
+        if isinstance(e, ast.UnaryOp):
+            return self._taint(e.operand)
+        if isinstance(e, ast.BoolOp):
+            return any(self._taint(v) for v in e.values)
+        if isinstance(e, ast.Compare):
+            return self._taint(e.left) or any(
+                self._taint(c) for c in e.comparators
+            )
+        if isinstance(e, ast.IfExp):
+            return (
+                self._taint(e.test) or self._taint(e.body)
+                or self._taint(e.orelse)
+            )
+        if isinstance(e, ast.Call):
+            dotted = _dotted(e.func)
+            if dotted in _HOST_CASTS or dotted in _NP_CONVERTERS:
+                # flagged as a sync point; the RESULT is a host value,
+                # so downstream use doesn't re-report
+                return False
+            return (
+                self._taint(e.func)
+                or any(self._taint(a) for a in e.args)
+                or any(self._taint(k.value) for k in e.keywords)
+            )
+        if isinstance(e, ast.Starred):
+            return self._taint(e.value)
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return any(self._taint(g.iter) for g in e.generators) or \
+                self._taint(e.elt)
+        if isinstance(e, ast.DictComp):
+            return any(self._taint(g.iter) for g in e.generators)
+        if isinstance(e, ast.JoinedStr):
+            return any(
+                self._taint(v.value) for v in e.values
+                if isinstance(v, ast.FormattedValue)
+            )
+        if isinstance(e, ast.Slice):
+            return any(
+                self._taint(x) for x in (e.lower, e.upper, e.step)
+                if x is not None
+            )
+        return False
+
+    def _expr(self, e: ast.expr) -> Optional[str]:
+        """Walk an expression emitting diagnostics; returns its dtype
+        lattice point (float/int/bool/None)."""
+        if isinstance(e, ast.Constant):
+            if isinstance(e.value, bool):
+                return "bool"
+            if isinstance(e.value, int):
+                return "int"
+            if isinstance(e.value, float):
+                return "float"
+            return None
+        if isinstance(e, ast.Name):
+            return self.dtypes.get(e.id)
+        if isinstance(e, ast.Attribute):
+            self._expr(e.value)
+            return _DTYPE_NAMES.get(e.attr)
+        if isinstance(e, ast.Subscript):
+            dt = self._expr(e.value)
+            self._expr(e.slice) if isinstance(e.slice, ast.expr) else None
+            return dt
+        if isinstance(e, ast.BinOp):
+            l, r = self._expr(e.left), self._expr(e.right)
+            if isinstance(e.op, ast.Div):
+                return "float"
+            return _join_dtype(l, r)
+        if isinstance(e, ast.UnaryOp):
+            dt = self._expr(e.operand)
+            if isinstance(e.op, ast.Not):
+                if self._taint(e.operand):
+                    self._emit(
+                        "DX300", e,
+                        "`not` on a traced value bool-converts the "
+                        "tracer; use jnp.logical_not",
+                    )
+                return "bool"
+            return dt
+        if isinstance(e, ast.BoolOp):
+            for v in e.values:
+                self._expr(v)
+            if any(self._taint(v) for v in e.values):
+                self._emit(
+                    "DX300", e,
+                    "short-circuit and/or on a traced value "
+                    "bool-converts the tracer; use & / | "
+                    "(jnp.logical_and/or)",
+                )
+            return "bool"
+        if isinstance(e, ast.Compare):
+            self._expr(e.left)
+            for c in e.comparators:
+                self._expr(c)
+            return "bool"
+        if isinstance(e, ast.IfExp):
+            self._expr(e.test)
+            if self._taint(e.test):
+                self._emit(
+                    "DX300", e,
+                    "conditional expression on a traced value "
+                    "bool-converts the tracer; use jnp.where",
+                )
+            return _join_dtype(self._expr(e.body), self._expr(e.orelse))
+        if isinstance(e, ast.Call):
+            return self._call(e)
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            for x in e.elts:
+                self._expr(x)
+            return None
+        if isinstance(e, ast.Dict):
+            for x in (*e.keys, *e.values):
+                if x is not None:
+                    self._expr(x)
+            return None
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                          ast.DictComp)):
+            for g in e.generators:
+                self._expr(g.iter)
+                if self._taint(g.iter):
+                    # iterating a tracer unrolls; range(tracer) dies —
+                    # both are flagged where the call is made (range)
+                    pass
+                for t in ast.walk(g.target):
+                    if isinstance(t, ast.Name):
+                        if self._taint(g.iter):
+                            self.tainted.add(t.id)
+            if isinstance(e, ast.DictComp):
+                self._expr(e.key)
+                self._expr(e.value)
+            else:
+                self._expr(e.elt)
+            return None
+        if isinstance(e, ast.JoinedStr):
+            for v in e.values:
+                if isinstance(v, ast.FormattedValue):
+                    self._expr(v.value)
+            return None
+        if isinstance(e, ast.Starred):
+            return self._expr(e.value)
+        if isinstance(e, ast.Lambda):
+            return None  # e.g. BlockSpec index maps — analyzed in place
+        if isinstance(e, ast.Slice):
+            for x in (e.lower, e.upper, e.step):
+                if x is not None:
+                    self._expr(x)
+            return None
+        return None
+
+    # -- calls: where most DX3xx findings live --------------------------
+    def _call(self, e: ast.Call) -> Optional[str]:
+        dotted = _dotted(e.func)
+        args_tainted = (
+            any(self._taint(a) for a in e.args)
+            or any(self._taint(k.value) for k in e.keywords)
+        )
+
+        # walk children first so nested calls report too
+        for a in e.args:
+            self._expr(a)
+        kw = {}
+        for k in e.keywords:
+            self._expr(k.value)
+            if k.arg:
+                kw[k.arg] = k.value
+
+        # method-style sync points: x.item(), x.tolist(), ...
+        if isinstance(e.func, ast.Attribute):
+            if e.func.attr in _SYNC_METHODS and self._taint(e.func.value):
+                self._emit(
+                    "DX301", e,
+                    f".{e.func.attr}() on a traced value forces a host "
+                    "sync — ConcretizationTypeError under jit",
+                )
+            if (
+                e.func.attr in _MUTATORS
+                and not self._taint(e.func.value)
+            ):
+                base = e.func.value
+                while isinstance(base, (ast.Attribute, ast.Subscript)):
+                    base = base.value
+                if isinstance(base, ast.Name) and base.id not in self.locals:
+                    self._emit(
+                        "DX302", e,
+                        f"mutating call .{e.func.attr}() on captured "
+                        f"object '{base.id}' runs once at trace time "
+                        "only",
+                    )
+            if e.func.attr == "astype":
+                self._expr(e.func.value)
+                if e.args:
+                    return self._dtype_of_node(e.args[0])
+                return None
+
+        # builtin concretizers / bool-converters
+        if dotted in _HOST_CASTS and args_tainted:
+            self._emit(
+                "DX301", e,
+                f"{dotted}() of a traced value cannot concretize under "
+                "jit (ConcretizationTypeError); keep it in jax.numpy",
+            )
+            return "float" if dotted == "float" else "int"
+        if dotted in _BOOL_BUILTINS and args_tainted:
+            self._emit(
+                "DX300", e,
+                f"{dotted}() over a traced value bool-converts tracer "
+                "elements; use the jnp equivalent",
+            )
+            return None
+        if dotted in _NP_CONVERTERS and args_tainted:
+            self._emit(
+                "DX301", e,
+                f"{dotted}() of a traced value falls off the device "
+                "(TracerArrayConversionError); use jnp instead of np",
+            )
+            return None
+
+        # impurity: I/O + host randomness/clock
+        if dotted in _IO_CALLS:
+            self._emit(
+                "DX302", e,
+                f"{dotted}() is I/O — it runs at trace time, not per "
+                "batch",
+            )
+            return None
+        if dotted and not dotted.startswith("jax."):
+            for p in _NONDET_PREFIXES:
+                if dotted == p.rstrip(".") or dotted.startswith(p):
+                    self._emit(
+                        "DX302", e,
+                        f"{dotted}() draws host entropy/wall-clock at "
+                        "trace time — the value freezes into the "
+                        "compiled step; use jax.random with an "
+                        "explicit key (or on_interval state)",
+                    )
+                    return None
+
+        # Pallas call-site hazards
+        if dotted.endswith("pallas_call") or dotted == "pallas_call":
+            self._pallas_call(e, kw)
+            return None
+
+        self._expr(e.func)
+
+        # dtype inference for the common jnp constructors/math
+        leaf = dotted.rsplit(".", 1)[-1] if dotted else ""
+        if leaf in _FLOAT_FNS:
+            return "float"
+        if leaf in _BOOL_FNS:
+            return "bool"
+        if leaf in ("zeros", "ones", "full", "empty", "zeros_like",
+                    "ones_like", "full_like", "arange"):
+            if "dtype" in kw:
+                return self._dtype_of_node(kw["dtype"])
+            return None
+        if leaf == "where" and len(e.args) == 3:
+            return _join_dtype(
+                self._expr(e.args[1]), self._expr(e.args[2])
+            )
+        if leaf in ("clip", "abs", "where", "maximum", "minimum"):
+            return None
+        if leaf in _DTYPE_NAMES and dotted.startswith(("jnp.", "jax.numpy.")):
+            return _DTYPE_NAMES[leaf]
+        return None
+
+    def _dtype_of_node(self, n: ast.expr) -> Optional[str]:
+        d = _dotted(n)
+        if d:
+            return _DTYPE_NAMES.get(d.rsplit(".", 1)[-1])
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            return _DTYPE_NAMES.get(n.value)
+        return None
+
+    def _pallas_call(self, e: ast.Call, kw: Dict[str, ast.expr]) -> None:
+        """Hazards at a user-written ``pl.pallas_call`` site."""
+        # out_shape: 2nd positional or keyword — required for lowering
+        if "out_shape" not in kw and len(e.args) < 2:
+            self._emit(
+                "DX305", e,
+                "pallas_call without out_shape — the kernel has no "
+                "output aval to lower against; pass "
+                "out_shape=jax.ShapeDtypeStruct(shape, dtype)",
+            )
+        for key in ("grid", "out_shape", "grid_spec"):
+            node = kw.get(key)
+            if node is not None and self._taint(node):
+                self._emit(
+                    "DX305", e,
+                    f"pallas_call {key}= derived from a traced value — "
+                    "the grid/output spec must be static; derive it "
+                    "from .shape, not from array contents",
+                )
+        # BlockSpec(...) anywhere in the call's arguments
+        for sub in ast.walk(e):
+            if isinstance(sub, ast.Call):
+                d = _dotted(sub.func)
+                if d.endswith("BlockSpec") and (
+                    any(self._taint(a) for a in sub.args)
+                    or any(self._taint(k.value) for k in sub.keywords)
+                ):
+                    self._emit(
+                        "DX305", sub,
+                        "BlockSpec derived from a traced value — block "
+                        "shapes/index maps must be static",
+                    )
+
+
+def _join_dtype(a: Optional[str], b: Optional[str]) -> Optional[str]:
+    if a == b:
+        return a
+    if {a, b} == {"int", "float"}:
+        return "float"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Object-level checks (closure introspection + out_type lattice)
+# ---------------------------------------------------------------------------
+def _captured_mutable(fn) -> List[str]:
+    """Names of mutable containers the function closes over or reads
+    from module globals — the state ``on_interval`` exists to refresh."""
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return []
+    out = []
+    for var, cell in zip(code.co_freevars, getattr(fn, "__closure__", None) or ()):
+        try:
+            v = cell.cell_contents
+        except ValueError:
+            continue
+        if isinstance(v, (dict, list, set, bytearray)):
+            out.append(var)
+    g = getattr(fn, "__globals__", {})
+    for var in code.co_names:
+        if var in g and isinstance(g[var], (dict, list, set, bytearray)):
+            out.append(var)
+    return sorted(set(out))
+
+
+def _declares_interval(obj) -> bool:
+    """True when the UDF declares a refresh hook: a non-None
+    ``_on_interval`` (the JaxUdf surface) or an ``on_interval`` the
+    object's own class defines (duck-typed UDFs)."""
+    if getattr(obj, "_on_interval", None) is not None:
+        return True
+    from ..udf import api as _api
+
+    for klass in type(obj).__mro__:
+        if "on_interval" in vars(klass):
+            return klass.__module__ != _api.__name__
+    return False
+
+
+def _device_fns(obj) -> List[Tuple[str, object, Tuple[str, ...]]]:
+    """(role, callable, untraced param names) per device function of a
+    UDF object. PallasUdf analyzes the kernel (its ``fn`` is the
+    library's own wrapper); scalar UDFs analyze ``fn``; aggregates
+    analyze ``reduce`` (``capacity`` is a static Python int by
+    contract)."""
+    kernel = getattr(obj, "kernel", None)
+    if callable(kernel):
+        return [("kernel", kernel, ())]
+    out: List[Tuple[str, object, Tuple[str, ...]]] = []
+    fn = getattr(obj, "fn", None)
+    if callable(fn):
+        out.append(("fn", fn, ()))
+    red = getattr(obj, "reduce", None)
+    if getattr(obj, "is_aggregate", False) and callable(red):
+        out.append(("reduce", red, ("capacity",)))
+    return out
+
+
+def check_udf_object(
+    obj, name: Optional[str] = None
+) -> Tuple[List[Diagnostic], List[str]]:
+    """Analyze one loaded UDF object; returns (diagnostics, roles
+    analyzed). The self-lint path for ``udf/samples.py`` objects; the
+    flow path (``analyze_flow_udfs``) adds the DX310 loader findings."""
+    udf_name = name or getattr(obj, "name", "") or type(obj).__name__
+    diags: List[Diagnostic] = []
+    roles: List[str] = []
+    ret_dtypes: List[Optional[str]] = []
+    for role, fn, untraced in _device_fns(obj):
+        node = _fn_node(fn)
+        # DX303 needs no source — it reads the live closure
+        captured = _captured_mutable(fn)
+        if captured and not _declares_interval(obj):
+            diags.append(make(
+                "DX303", udf_name,
+                f"{role}: captures mutable state {captured} with no "
+                "on_interval declared — the jitted step bakes the "
+                "state in at trace time and silently serves stale "
+                "values after any update",
+                Span(line=node.lineno if node is not None else 0),
+            ))
+        if node is None:
+            continue
+        roles.append(role)
+        lint = _FnLinter(
+            node, udf_name, role, untraced_params=untraced
+        ).run()
+        diags.extend(lint.diags)
+        if role in ("fn", "reduce"):
+            ret_dtypes.extend(lint.return_dtypes)
+
+    # DX304: declared out_type vs the inferred return dtype
+    out_type = getattr(obj, "out_type", None)
+    if isinstance(out_type, str):
+        declared = _DECLARED_DTYPE.get(out_type.lower())
+        known = {d for d in ret_dtypes if d is not None}
+        if declared and len(known) == 1 and ret_dtypes and \
+                all(d is not None for d in ret_dtypes):
+            inferred = known.pop()
+            if inferred != declared:
+                diags.append(make(
+                    "DX304", udf_name,
+                    f"declared out_type '{out_type}' maps to {declared} "
+                    f"but the function returns {inferred} under the "
+                    "type lattice — results decode through the wrong "
+                    "column type",
+                ))
+    return diags, roles
+
+
+# ---------------------------------------------------------------------------
+# Flow-level entry point (the production-loader path)
+# ---------------------------------------------------------------------------
+@dataclass
+class UdfSummary:
+    name: str
+    tier: str  # udf | udaf
+    path: str  # package.module:attr
+    kind: str  # class name of the loaded object ("" when unloadable)
+    analyzed: List[str] = field(default_factory=list)  # roles walked
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "tier": self.tier,
+            "path": self.path,
+            "kind": self.kind,
+            "analyzed": list(self.analyzed),
+        }
+
+
+@dataclass
+class UdfCheckReport:
+    flow: str
+    udfs: List[UdfSummary]
+    diagnostics: List[Diagnostic]
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.is_error]
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if not d.is_error]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def udfs_dict(self) -> dict:
+        return {
+            "flow": self.flow,
+            "functions": [u.to_dict() for u in self.udfs],
+        }
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "errorCount": len(self.errors),
+            "warningCount": len(self.warnings),
+            "diagnostics": [d.to_dict() for d in self.diagnostics],
+            "udfs": self.udfs_dict(),
+        }
+
+
+_UDF_TYPES = {"udf": "udf", "jarudf": "udf", "pythonudf": "udf",
+              "udaf": "udaf", "jarudaf": "udaf"}
+
+
+def analyze_flow_udfs(flow: dict) -> UdfCheckReport:
+    """UDF-tier analysis of a flow config (gui JSON or full flow
+    document): resolve every declared function through the PRODUCTION
+    loader (``load_udfs_from_conf`` — same reflection path, same
+    rejections), then abstract-interpret each device function's AST."""
+    from ..udf.api import load_udfs_from_conf
+
+    gui = flow.get("gui") if isinstance(flow.get("gui"), dict) else flow
+    name = gui.get("name") or ""
+    proc = gui.get("process") or {}
+    diags: List[Diagnostic] = []
+    summaries: List[UdfSummary] = []
+    seen: Dict[str, str] = {}
+    for entry in proc.get("functions") or []:
+        ftype = (entry.get("type") or "udf").lower()
+        tier = _UDF_TYPES.get(ftype)
+        if tier is None:
+            continue  # azure functions are a sink tier, not compiled
+        fid = entry.get("id") or ""
+        props = entry.get("properties") or {}
+        path = props.get("module") or props.get("class") or ""
+        if not fid or not path:
+            diags.append(make(
+                "DX310", fid,
+                "ill-formed UDF conf entry: both id and "
+                "properties.module (package.module:attr) are required",
+            ))
+            continue
+        if fid.lower() in seen:
+            diags.append(make(
+                "DX310", fid,
+                f"duplicate UDF name '{fid}' (also declared as "
+                f"{seen[fid.lower()]}) — registration is "
+                "case-insensitive and last-wins would silently shadow "
+                "the first",
+            ))
+            continue
+        seen[fid.lower()] = path
+        conf = SettingDictionary({
+            f"datax.job.process.jar.{tier}.{fid}.class": path,
+        })
+        try:
+            obj = load_udfs_from_conf(conf)[fid.lower()]
+        except EngineException as e:
+            diags.append(make("DX310", fid, str(e)))
+            summaries.append(UdfSummary(fid, tier, path, ""))
+            continue
+        if tier == "udaf" and not (
+            getattr(obj, "is_aggregate", False)
+            and callable(getattr(obj, "reduce", None))
+        ):
+            diags.append(make(
+                "DX310", fid,
+                f"udaf '{fid}' ({path}) is not an aggregate — it must "
+                "set is_aggregate and provide reduce(arg_arrays, seg, "
+                "capacity, valid_s)",
+            ))
+            summaries.append(
+                UdfSummary(fid, tier, path, type(obj).__name__)
+            )
+            continue
+        obj_diags, roles = check_udf_object(obj, name=fid)
+        diags.extend(obj_diags)
+        summaries.append(
+            UdfSummary(fid, tier, path, type(obj).__name__, roles)
+        )
+    diags = sorted(
+        diags, key=lambda d: (d.severity != "error", d.span.line, d.code)
+    )
+    return UdfCheckReport(name, summaries, diags)

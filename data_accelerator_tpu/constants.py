@@ -101,6 +101,9 @@ class MetricName:
         r"Output_[A-Za-z0-9_.]+_(GroupsDropped|JoinRowsDropped)",
         r"Sink_[a-z]+",
         r"Batch_Files_Count",
+        # UDF on_interval hooks that threw (refresh skipped, previous
+        # trace kept serving — runtime/processor.py dispatch_batch)
+        r"UdfRefreshError",
     )
 
     @classmethod

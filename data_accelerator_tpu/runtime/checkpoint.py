@@ -20,6 +20,20 @@ from typing import Dict, List, Optional, Tuple
 from ..obs.tracing import span as _trace_span
 
 
+def _durable_replace(tmp: str, dst: str) -> None:
+    """``os.replace`` with power-loss durability: fsync the temp file
+    before the rename (data hits the platter, not just the page cache)
+    and fsync the directory after it (the rename itself is a directory
+    entry). Without both, a crash-then-power-loss can surface a zero
+    -length or missing checkpoint even though the process "wrote" it."""
+    os.replace(tmp, dst)
+    dir_fd = os.open(os.path.dirname(dst) or ".", os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
+
+
 @dataclass(frozen=True)
 class PartitionOffset:
     ts_ms: int
@@ -46,7 +60,9 @@ class OffsetCheckpointer:
         return os.path.join(self.dir, self.BACKUP)
 
     def write_offsets(self, offsets: List[PartitionOffset]) -> None:
-        """Backup then write, as the reference does (scala :43-61)."""
+        """Backup then write, as the reference does (scala :43-61) —
+        fsynced so the checkpoint survives power loss, not just a
+        process crash."""
         if os.path.exists(self.path):
             shutil.copyfile(self.path, self.backup_path)
         tmp = self.path + ".tmp"
@@ -55,7 +71,9 @@ class OffsetCheckpointer:
                 f.write(
                     f"{o.ts_ms},{o.source},{o.partition},{o.from_seq},{o.until_seq}\n"
                 )
-        os.replace(tmp, self.path)
+            f.flush()
+            os.fsync(f.fileno())
+        _durable_replace(tmp, self.path)
 
     def read_offsets(self) -> List[PartitionOffset]:
         """Read current file, falling back to the backup (scala :63-73)."""
@@ -169,7 +187,9 @@ class WindowStateCheckpointer:
         tmp = self.path + ".tmp"
         with open(tmp, "wb") as f:
             np.savez(f, **arrays)
-        os.replace(tmp, self.path)
+            f.flush()
+            os.fsync(f.fileno())
+        _durable_replace(tmp, self.path)
 
     def load(self) -> Optional[Dict]:
         """Restore a snapshot dict, falling back to the backup; None when

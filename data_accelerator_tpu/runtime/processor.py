@@ -321,6 +321,23 @@ class FlowProcessor:
         process_conf = dict_.get_sub_dictionary(SettingNamespace.JobProcessPrefix)
         self.process_conf = process_conf
 
+        # sanitizer wiring — the runtime counterpart of the DX3xx UDF
+        # analyzer: conf process.debug.nans / process.debug.tracerleaks
+        # arm jax.debug_nans and tracer-leak checking around the jitted
+        # step, turning surviving UDF impurity (NaNs from bad math,
+        # tracers stashed in closures/globals) into loud failures in
+        # test jobs instead of silent corruption
+        dbg_conf = process_conf.get_sub_dictionary("debug.")
+        self.debug_nans = (
+            dbg_conf.get_or_else("nans", "false") or ""
+        ).lower() == "true"
+        self.debug_tracer_leaks = (
+            dbg_conf.get_or_else("tracerleaks", "false") or ""
+        ).lower() == "true"
+        # on_interval failures skipped this/previous batches, drained
+        # into the DATAX-<flow>:UdfRefreshError metric at collect()
+        self.udf_refresh_errors = 0
+
         self.interval_s = float(
             input_conf.get_or_else("streaming.intervalinseconds", "1")
         )
@@ -1048,6 +1065,22 @@ class FlowProcessor:
     def _empty_raw(self, spec: SourceSpec) -> TableData:
         return self.encode_columns({}, 0, source=spec.name)
 
+    def _debug_guard(self):
+        """Context armed by the ``process.debug`` conf block around the
+        jitted step: ``jax.debug_nans`` re-runs de-optimized on the
+        first NaN and names the producing primitive; tracer-leak
+        checking raises when user code lets a tracer escape the traced
+        step. Both sanitize UDF-bearing test jobs — off (a no-op stack)
+        in production confs."""
+        import contextlib
+
+        stack = contextlib.ExitStack()
+        if self.debug_nans:
+            stack.enter_context(jax.debug_nans(True))
+        if self.debug_tracer_leaks:
+            stack.enter_context(jax.checking_leaks())
+        return stack
+
     def dispatch_batch(
         self,
         raw: Union[TableData, Dict[str, TableData]],
@@ -1081,12 +1114,18 @@ class FlowProcessor:
             for name, spec in self.specs.items()
         }
         # per-interval UDF refresh hooks; state changes re-trace the step
-        # (CommonProcessorFactory.scala:351-353 onInterval invocation)
+        # (CommonProcessorFactory.scala:351-353 onInterval invocation).
+        # A throwing hook skips its refresh (previous trace keeps
+        # serving) and surfaces as the UdfRefreshError metric rather
+        # than killing the batch loop.
         from ..udf import UdfRegistry
 
-        if UdfRegistry(self.udfs).refresh(batch_time_ms):
+        registry = UdfRegistry(self.udfs)
+        if registry.refresh(batch_time_ms):
             self._build_pipeline(self.output_datasets)
             self._jit_step()  # the old jit closed over the old pipeline
+        if registry.last_errors:
+            self.udf_refresh_errors += len(registry.last_errors)
         # whole-second base so device absolute-time math is exact
         new_base_ms = (batch_time_ms // 1000) * 1000
         if self._base_ms is None:
@@ -1118,7 +1157,7 @@ class FlowProcessor:
         aux = self.aux_tables.tables()
         # child span of the host's "dispatch" when a batch trace is
         # active (obs/tracing.py); a no-op under bench/LiveQuery drivers
-        with _trace_span("device-enqueue"):
+        with _trace_span("device-enqueue"), self._debug_guard():
             out_datasets, new_rings, new_state, counts_vec = self._step(
                 raw, self.window_buffers, self.state_data, refdata_tables,
                 base_s, now_rel_ms, counter, jnp.asarray(delta_ms, jnp.int32),
@@ -1347,4 +1386,10 @@ class PendingBatch:
                 proc.dictionary.overflow_count
             )
             proc.dictionary.overflow_count = 0
+        # on_interval hooks that threw since the last collect: their
+        # refreshes were skipped (previous trace kept serving) — loud
+        # in metrics, invisible to the batch loop
+        if proc.udf_refresh_errors:
+            metrics["UdfRefreshError"] = float(proc.udf_refresh_errors)
+            proc.udf_refresh_errors = 0
         return datasets, metrics

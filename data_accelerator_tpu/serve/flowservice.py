@@ -77,6 +77,16 @@ class FlowOperation:
 
         return analyze_flow_device(flow, chips=chips)
 
+    def validate_flow_udfs(self, flow: dict):
+        """The UDF tier of ``flow/validate`` (``udfs: true``): every
+        declared UDF/UDAF resolves through the production loader and
+        its device functions are abstract-interpreted under the taint
+        lattice — the DX3xx tracing-safety/purity/determinism lints.
+        Same implementation as the CLI's ``--udfs``."""
+        from ..analysis import analyze_flow_udfs
+
+        return analyze_flow_udfs(flow)
+
     def generate_configs(self, flow_name: str) -> GenerationResult:
         doc = self.design.get_by_name(flow_name)
         if doc is not None:

@@ -63,6 +63,10 @@ class Kernel:
     sample_rows: List[dict]
     udfs: Optional[dict] = None
     refdata_conf: Dict[str, str] = field(default_factory=dict)
+    # sanitizer flags for UDF-bearing interactive runs: True arms both
+    # jax.debug_nans and tracer-leak checking; a dict selects
+    # individual process.debug.* flags ({"nans": "true"})
+    debug: object = None
     created_at: float = field(default_factory=time.time)
     last_used: float = field(default_factory=time.time)
     _processors: Dict[str, object] = field(default_factory=dict)
@@ -87,6 +91,18 @@ class Kernel:
                 max(1, int(max_window_s))
             )
         conf.update(self.refdata_conf)
+        if self.debug:
+            # process.debug conf block (runtime/processor.py): the
+            # kernel's one-batch runs are exactly the "test job" the
+            # sanitizers exist for — impure/NaN-producing UDFs fail
+            # loudly here instead of shipping
+            flags = (
+                {"nans": "true", "tracerleaks": "true"}
+                if self.debug is True
+                else {k: str(v).lower() for k, v in dict(self.debug).items()}
+            )
+            for k, v in flags.items():
+                conf[f"datax.job.process.debug.{k}"] = v
         return SettingDictionary(conf)
 
     def _timestamp_column(self) -> Optional[str]:
@@ -250,11 +266,14 @@ class KernelService:
         sample_rows: Optional[List[dict]] = None,
         udfs: Optional[dict] = None,
         refdata_conf: Optional[Dict[str, str]] = None,
+        debug: object = None,
     ) -> str:
         """Create + initialize a kernel; returns kernel id.
 
         Sample rows default to the flow's persisted sample blob
-        (written by SchemaInferenceManager)."""
+        (written by SchemaInferenceManager). ``debug`` arms the
+        ``process.debug`` sanitizers (jax.debug_nans + tracer-leak
+        checking) for this kernel's runs."""
         if sample_rows is None:
             sample_rows = self._load_sample(flow_name)
         if not isinstance(schema_json, str):
@@ -268,6 +287,7 @@ class KernelService:
             sample_rows=sample_rows or [],
             udfs=udfs,
             refdata_conf=refdata_conf or {},
+            debug=debug,
         )
         with self._lock:
             self._gc_locked()

@@ -169,7 +169,10 @@ class DataXApi:
         ``"device": true`` adds the device-plan tier (the CLI's
         ``--device``): DX2xx lints merged into the diagnostics plus a
         ``device`` cost report (per-stage HBM/FLOP/ICI); optional
-        ``"chips": N`` sets the ICI model's chip count."""
+        ``"chips": N`` sets the ICI model's chip count. ``"udfs":
+        true`` adds the UDF tier (the CLI's ``--udfs``): DX3xx
+        tracing-safety/purity lints merged into the diagnostics plus a
+        ``udfs`` summary of the functions analyzed."""
         flow = body.get("flow") or body.get("gui")
         if flow is None and (body.get("flowName") or body.get("name")) \
                 and not body.get("process") and not body.get("input"):
@@ -179,15 +182,21 @@ class DataXApi:
         if flow is None:
             flow = body
         report = self.flow_ops.validate_flow(flow)
-        if not body.get("device"):
+        if not body.get("device") and not body.get("udfs"):
             return report.to_dict()
         from ..analysis import combined_report_dict
 
-        chips = body.get("chips")
-        device = self.flow_ops.validate_flow_device(
-            flow, chips=int(chips) if chips else None
+        device = None
+        if body.get("device"):
+            chips = body.get("chips")
+            device = self.flow_ops.validate_flow_device(
+                flow, chips=int(chips) if chips else None
+            )
+        udfs = (
+            self.flow_ops.validate_flow_udfs(flow)
+            if body.get("udfs") else None
         )
-        return combined_report_dict(report, device)
+        return combined_report_dict(report, device, udfs)
 
     def _flow_generate(self, body, query):
         res = self.flow_ops.generate_configs(self._flow_name(body, query))
@@ -378,6 +387,10 @@ class DataXApi:
             "schema_json": schema_json,
             "normalization": normalization,
             "sample_rows": sample_rows,
+            # sanitizer opt-in for interactive UDF runs ("debug": true
+            # or {"nans": "true", "tracerleaks": "true"}) — the
+            # process.debug conf block, LiveQuery edition
+            "debug": body.get("debug"),
         }
 
 

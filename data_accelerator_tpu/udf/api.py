@@ -165,6 +165,7 @@ class UdfRegistry:
 
     def __init__(self, udfs: Optional[Dict[str, object]] = None):
         self._udfs: Dict[str, object] = dict(udfs or {})
+        self.last_errors: List[str] = []
 
     def register(self, udf) -> None:
         self._udfs[udf.name.lower()] = udf
@@ -175,12 +176,27 @@ class UdfRegistry:
     def refresh(self, batch_time_ms: int) -> bool:
         """Run every UDF's interval hook; True if any state changed
         (caller re-traces the step). reference: udf.onInterval invocation
-        at CommonProcessorFactory.scala:351-353."""
+        at CommonProcessorFactory.scala:351-353.
+
+        A throwing hook must not kill the batch loop: that refresh is
+        skipped (the previous trace keeps serving, with its previous
+        state) and the UDF's name lands in ``last_errors`` so the host
+        can emit the ``UdfRefreshError`` metric."""
         changed = False
-        for udf in self._udfs.values():
+        self.last_errors = []
+        for name, udf in self._udfs.items():
             hook = getattr(udf, "on_interval", None)
-            if hook is not None and hook(batch_time_ms):
-                changed = True
+            if hook is None:
+                continue
+            try:
+                if hook(batch_time_ms):
+                    changed = True
+            except Exception:  # noqa: BLE001 — user refresh hook
+                logger.exception(
+                    "on_interval failed for UDF %s; skipping refresh and "
+                    "keeping the previous trace", name,
+                )
+                self.last_errors.append(name)
         return changed
 
 
@@ -205,8 +221,19 @@ def load_udfs_from_conf(dict_: SettingDictionary) -> Dict[str, object]:
       datax.job.process.jar.udf.<name>.class  = pkg.mod:attr
       datax.job.process.jar.udaf.<name>.class = pkg.mod:attr
     The attr is either a UDF object or a zero-arg factory returning one.
+
+    Registration is case-insensitive, so a name declared twice (across
+    the udf/udaf tiers or differing only in case) would silently
+    last-win, and a name matching an engine builtin would never be
+    called (the compiler resolves builtins first) — both are rejected
+    with a typed ``EngineException`` instead.
     """
+    # lazy: analysis owns the builtin-function registry the compiler
+    # resolves before UDFs (analysis/typeprop.py BUILTIN_FNS)
+    from ..analysis.typeprop import BUILTIN_FNS
+
     out: Dict[str, object] = {}
+    declared_as: Dict[str, str] = {}  # lowercase name -> "tier 'Name'"
     for tier in ("udf", "udaf"):
         ns = f"datax.job.process.jar.{tier}."
         grouped = dict_.get_sub_dictionary(ns).group_by_sub_namespace()
@@ -214,6 +241,21 @@ def load_udfs_from_conf(dict_: SettingDictionary) -> Dict[str, object]:
             cls_path = sub.get("class")
             if not cls_path:
                 continue
+            key = name.lower()
+            if key in declared_as:
+                raise EngineException(
+                    f"duplicate UDF name: {tier} '{name}' is already "
+                    f"declared as {declared_as[key]} (names are "
+                    "case-insensitive; last-wins would silently shadow "
+                    "the first)"
+                )
+            if name.upper() in BUILTIN_FNS:
+                raise EngineException(
+                    f"{tier} '{name}' shadows the engine builtin "
+                    f"{name.upper()}: the compiler resolves builtins "
+                    "first, so this UDF would never be called — rename it"
+                )
+            declared_as[key] = f"{tier} '{name}'"
             try:
                 obj = _import_attr(cls_path)
                 if isinstance(obj, type) or not hasattr(obj, "compile_call"):
@@ -227,6 +269,6 @@ def load_udfs_from_conf(dict_: SettingDictionary) -> Dict[str, object]:
                     f"{tier} '{name}' ({cls_path}) is not a UDF object"
                 )
             obj.name = name
-            out[name.lower()] = obj
+            out[key] = obj
             logger.info("registered %s %s from %s", tier, name, cls_path)
     return out

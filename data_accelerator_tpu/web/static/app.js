@@ -131,7 +131,8 @@ route("#/flow/", async (view, hash) => {
   };
   // inline diagnostics from the flow static analyzer (flow/validate —
   // same DXnnn diagnostics as `python -m data_accelerator_tpu.analysis`,
-  // device tier included: the DX2xx lints + per-stage cost table)
+  // device + udf tiers included: DX2xx lints + per-stage cost table,
+  // DX3xx UDF tracing-safety lints + analyzed-function summary)
   const diagBox = h("div", { class: "diags" });
   const fmtBytes = (n) => {
     for (const u of ["B", "KB", "MB", "GB"]) {
@@ -161,6 +162,14 @@ route("#/flow/", async (view, hash) => {
           h("td", { class: "num" }, s.flops ? fmtVal(s.flops) : "–"),
           h("td", { class: "num" }, s.iciBytes ? fmtBytes(s.iciBytes) : "–"))))));
   };
+  const renderUdfSummary = (u) => {
+    if (!u || !u.functions || !u.functions.length) return null;
+    return h("div", { class: "muted" },
+      "udf tier: " + u.functions.map((f) =>
+        `${f.name} [${f.tier}] ${f.kind || "unloadable"}` +
+        (f.analyzed && f.analyzed.length ? ` (${f.analyzed.join(",")})` : "")
+      ).join(" · "));
+  };
   const renderDiags = (r) => {
     diagBox.replaceChildren(
       h("div", { class: "muted" },
@@ -171,12 +180,13 @@ route("#/flow/", async (view, hash) => {
         d.table ? h("span", { class: "diag-table" }, d.table) : null,
         h("span", {}, d.message),
         d.span && d.span.line ? h("span", { class: "muted" }, ` line ${d.span.line}`) : null)),
+      renderUdfSummary(r.udfs),
       renderCostTable(r.device));
   };
   const validate = async () => {
     await save();
     const r = await api("POST", "/api/flow/flow/validate",
-      { flow: gui, device: true });
+      { flow: gui, device: true, udfs: true });
     renderDiags(r);
     toast(r.ok ? "flow is clean" : `${r.errorCount} error(s) found`, r.ok);
     return r;

@@ -1,0 +1,4 @@
+# golden-fixture UDF modules for the DX3xx analyzer tier: one module
+# per code, each with a `bad` factory (the flagged pattern) and a
+# `clean` twin (same job, tracing-safe). tests/test_udfcheck.py pairs
+# every analyzer verdict with a runtime ground-truth test over these.

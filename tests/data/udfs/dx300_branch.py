@@ -1,0 +1,24 @@
+"""DX300 fixture: data-dependent Python control flow on a traced value."""
+
+import jax.numpy as jnp
+
+from data_accelerator_tpu.udf.api import JaxUdf
+
+
+def _bad_fn(x):
+    if x.sum() > 0:  # tracer in `if` -> TracerBoolConversionError
+        return x.astype(jnp.float32)
+    return -x.astype(jnp.float32)
+
+
+def bad() -> JaxUdf:
+    return JaxUdf("branchy", _bad_fn, out_type="double")
+
+
+def _clean_fn(x):
+    y = x.astype(jnp.float32)
+    return jnp.where(x.sum() > 0, y, -y)
+
+
+def clean() -> JaxUdf:
+    return JaxUdf("branchy", _clean_fn, out_type="double")

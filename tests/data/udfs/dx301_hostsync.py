@@ -1,0 +1,23 @@
+"""DX301 fixture: host sync point on a traced value."""
+
+import jax.numpy as jnp
+
+from data_accelerator_tpu.udf.api import JaxUdf
+
+
+def _bad_fn(x):
+    mu = float(x[0])  # concretizes the tracer -> ConcretizationTypeError
+    return x.astype(jnp.float32) * mu
+
+
+def bad() -> JaxUdf:
+    return JaxUdf("scalemu", _bad_fn, out_type="double")
+
+
+def _clean_fn(x):
+    mu = x[0].astype(jnp.float32)  # stays on device
+    return x.astype(jnp.float32) * mu
+
+
+def clean() -> JaxUdf:
+    return JaxUdf("scalemu", _clean_fn, out_type="double")

@@ -1,0 +1,28 @@
+"""DX302 fixture: impure device function mutating captured state.
+
+The bad twin appends to a module-level list per call — under jit the
+append runs once at trace time, then never again (the desync the
+runtime ground-truth test demonstrates)."""
+
+import jax.numpy as jnp
+
+from data_accelerator_tpu.udf.api import JaxUdf
+
+CALLS = []  # noqa: the captured state the bad twin mutates
+
+
+def _bad_fn(x):
+    CALLS.append(1)  # trace-time-only side effect
+    return x.astype(jnp.float32) * 2.0
+
+
+def bad() -> JaxUdf:
+    return JaxUdf("doubler", _bad_fn, out_type="double")
+
+
+def _clean_fn(x):
+    return x.astype(jnp.float32) * 2.0
+
+
+def clean() -> JaxUdf:
+    return JaxUdf("doubler", _clean_fn, out_type="double")

@@ -1,0 +1,32 @@
+"""DX303 fixture: captured mutable state with no on_interval declared.
+
+The bad twin closes over a dict and never declares a refresh hook —
+the jitted step bakes the factor in at trace time, so later updates to
+the dict silently do nothing (DynamicUDF.onInterval gap)."""
+
+import jax.numpy as jnp
+
+from data_accelerator_tpu.udf.api import JaxUdf
+
+
+def bad() -> JaxUdf:
+    state = {"factor": 2.0}
+    return JaxUdf(
+        "scalest",
+        lambda x: x.astype(jnp.float32) * state["factor"],
+        out_type="double",
+    )
+
+
+def clean() -> JaxUdf:
+    state = {"factor": 2.0}
+
+    def refresh(batch_time_ms: int) -> bool:
+        return False  # flip to True when state changes -> re-trace
+
+    return JaxUdf(
+        "scalest",
+        lambda x: x.astype(jnp.float32) * state["factor"],
+        out_type="double",
+        on_interval=refresh,
+    )

@@ -1,0 +1,21 @@
+"""DX304 fixture: declared out_type disagrees with the return dtype.
+
+The bad twin declares ``long`` but computes a float — the pipeline
+decodes the column through the declared type and silently truncates
+(0.5*5 -> 2, not 2.5), which the runtime ground-truth test asserts."""
+
+import jax.numpy as jnp
+
+from data_accelerator_tpu.udf.api import JaxUdf
+
+
+def _half(x):
+    return x.astype(jnp.float32) * 0.5
+
+
+def bad() -> JaxUdf:
+    return JaxUdf("halfit", _half, out_type="long")
+
+
+def clean() -> JaxUdf:
+    return JaxUdf("halfit", _half, out_type="double")

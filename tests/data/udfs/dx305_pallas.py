@@ -1,0 +1,40 @@
+"""DX305 fixture: Pallas kernel hazards at a user-written pallas_call.
+
+The bad twin derives the grid from array CONTENTS (a traced value) and
+omits ``out_shape`` — neither can lower. The clean twin derives
+everything from static ``.shape`` and passes the output aval."""
+
+import jax
+import jax.numpy as jnp
+
+from data_accelerator_tpu.udf.api import JaxUdf
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...].astype(jnp.float32) * 2.0
+
+
+def _bad_fn(x):
+    from jax.experimental import pallas as pl
+
+    g = x[0] + 1  # grid from array contents: traced
+    return pl.pallas_call(_kernel, grid=(g,))(x)
+
+
+def bad() -> JaxUdf:
+    return JaxUdf("pdouble", _bad_fn, out_type="double")
+
+
+def _clean_fn(x):
+    from jax.experimental import pallas as pl
+
+    n = x.shape[0]  # static under tracing
+    return pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((n,), jnp.float32),
+        interpret=jax.default_backend() != "tpu",
+    )(x)
+
+
+def clean() -> JaxUdf:
+    return JaxUdf("pdouble", _clean_fn, out_type="double")

@@ -1,0 +1,23 @@
+"""DX310 fixture: conf declares a udaf whose target is not an
+aggregate (no ``reduce``) — the reference's JarUDFHandler would have
+rejected the registration; loading it blind dies at the first
+GROUP BY."""
+
+import jax.numpy as jnp
+
+from data_accelerator_tpu.udf.api import JaxUdaf, JaxUdf
+
+
+def bad() -> JaxUdf:
+    # a scalar UDF declared under the udaf tier: no reduce
+    return JaxUdf("lastval", lambda x: x.astype(jnp.float32), out_type="double")
+
+
+def clean() -> JaxUdaf:
+    def reduce(arg_arrays, seg, capacity, valid_s):
+        from data_accelerator_tpu.ops.groupby import segment_aggregate
+
+        vals = arg_arrays[0].astype(jnp.float32)
+        return segment_aggregate(vals, seg, capacity, "max", valid_s)
+
+    return JaxUdaf("lastval", reduce, out_type="double")

@@ -26,6 +26,8 @@ from data_accelerator_tpu.analysis import (
     SEV_WARNING,
     analyze_flow,
     analyze_flow_device,
+    analyze_flow_udfs,
+    check_udf_object,
 )
 from data_accelerator_tpu.serve.scenarios import shipped_flow_guis
 
@@ -113,8 +115,45 @@ def test_golden_device_diagnostic(fixture, code, severity):
     assert report.ok == (severity != SEV_ERROR)
 
 
+# UDF tier (analyze_flow_udfs / --udfs): fixture, code, severity. Each
+# fixture flow declares a `bad` UDF factory from tests/data/udfs/; the
+# `clean` twin in the same module must analyze clean (asserted by
+# swapping the module attr). Runtime ground truth for every code lives
+# in tests/test_udfcheck.py.
+UDF_GOLDEN = [
+    ("dx300_udf_branch", "DX300", SEV_ERROR),
+    ("dx301_udf_hostsync", "DX301", SEV_ERROR),
+    ("dx302_udf_impure", "DX302", SEV_WARNING),
+    ("dx303_udf_stale", "DX303", SEV_WARNING),
+    ("dx304_udf_outtype", "DX304", SEV_WARNING),
+    ("dx305_udf_pallas", "DX305", SEV_ERROR),
+    ("dx310_udf_unloadable", "DX310", SEV_ERROR),
+]
+
+
+@pytest.mark.parametrize("fixture,code,severity", UDF_GOLDEN,
+                         ids=[g[0] for g in UDF_GOLDEN])
+def test_golden_udf_diagnostic(fixture, code, severity):
+    flow = load_flow(fixture)
+    # udf-tier-only findings: the semantic tier stays clean on them
+    assert analyze_flow(flow).errors == []
+    report = analyze_flow_udfs(flow)
+    hits = [d for d in report.diagnostics if d.code == code]
+    assert hits, f"expected {code}, got {[d.code for d in report.diagnostics]}"
+    assert hits[0].severity == severity
+    assert hits[0].severity == CODES[code][0]
+    assert report.ok == (severity != SEV_ERROR)
+    # the clean twin (same module, `clean` factory) analyzes clean
+    twin = json.loads(json.dumps(flow).replace(":bad", ":clean"))
+    assert analyze_flow_udfs(twin).diagnostics == []
+
+
 def test_every_registered_code_has_a_golden_fixture():
-    assert {g[1] for g in GOLDEN} | {g[1] for g in DEVICE_GOLDEN} == set(CODES)
+    assert (
+        {g[1] for g in GOLDEN}
+        | {g[1] for g in DEVICE_GOLDEN}
+        | {g[1] for g in UDF_GOLDEN}
+    ) == set(CODES)
 
 
 def test_analysis_md_documents_every_code():
@@ -176,6 +215,47 @@ def test_self_lint_generation_sample_flow():
 
     report = analyze_flow(make_gui("SelfLint"))
     assert report.errors == [], [d.render() for d in report.errors]
+
+
+def test_udf_self_lint_shipped_and_baseline_flows():
+    """Tier-1 gate for the UDF tier: every shipped scenario flow AND
+    every clean baseline-mirror fixture passes ``--udfs`` analysis
+    clean — the sample UDFs the repo ships must satisfy the pure-and-
+    traceable contract their own analyzer enforces."""
+    flows = [(g.get("name"), g) for g in shipped_flow_guis()]
+    for path in clean_flow_paths():
+        with open(path) as f:
+            flows.append((os.path.basename(path), json.load(f)))
+    assert len(flows) >= 6
+    for name, flow in flows:
+        report = analyze_flow_udfs(flow)
+        assert report.diagnostics == [], (
+            f"{name}: {[d.render() for d in report.diagnostics]}"
+        )
+
+
+def test_udf_self_lint_sample_objects():
+    """Every shipped sample UDF in udf/samples.py passes the object-
+    level analyzer with zero diagnostics — a sample regression (an
+    impure edit, a tracer branch) fails CI here."""
+    from data_accelerator_tpu.udf.samples import (
+        HelloWorldUdf,
+        anomalyscore,
+        lastabove,
+        scaleby,
+    )
+
+    for make_udf in (scaleby, lastabove, anomalyscore, HelloWorldUdf):
+        obj = make_udf()
+        diags, _roles = check_udf_object(obj)
+        assert diags == [], (
+            f"{getattr(obj, 'name', type(obj).__name__)}: "
+            f"{[d.render() for d in diags]}"
+        )
+    # the tiers with a device function were actually walked, not skipped
+    assert check_udf_object(scaleby())[1] == ["fn"]
+    assert check_udf_object(lastabove())[1] == ["reduce"]
+    assert check_udf_object(anomalyscore())[1] == ["kernel"]
 
 
 def test_device_self_lint_shipped_and_baseline_flows():
@@ -359,6 +439,113 @@ def test_cli_device_json_matches_validate_endpoint():
     assert out["result"]["diagnostics"] == cli_report["diagnostics"]
     assert out["result"]["device"]["stages"] == cli_report["device"]["stages"]
     assert out["result"]["device"]["totals"] == cli_report["device"]["totals"]
+
+
+# ---------------------------------------------------------------------------
+# CLI --udfs tier: same exit contract (0 clean incl. warnings, 1 on
+# udf-tier errors), and parity with the REST ``udfs: true`` path
+# ---------------------------------------------------------------------------
+def test_cli_udfs_zero_exit_on_clean_configs(tmp_path):
+    paths = clean_flow_paths()
+    for i, gui in enumerate(shipped_flow_guis()):
+        p = tmp_path / f"scenario{i}.json"
+        p.write_text(json.dumps(gui))
+        paths.append(str(p))
+    proc = _run_cli(["--udfs", *paths])
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    # the analyzed-function summary rendered for the UDF-bearing flow
+    assert "udf anomalyscore [udf] PallasUdf" in proc.stdout
+
+
+def test_cli_udfs_nonzero_on_tracer_branch():
+    proc = _run_cli([
+        "--udfs", os.path.join(FLOWS_DIR, "dx300_udf_branch.json"),
+    ])
+    assert proc.returncode == 1, proc.stdout
+    assert "DX300" in proc.stdout
+    # without --udfs the same flow exits clean: the finding is
+    # udf-tier-only
+    proc2 = _run_cli([os.path.join(FLOWS_DIR, "dx300_udf_branch.json")])
+    assert proc2.returncode == 0, proc2.stdout
+
+
+def test_cli_udfs_warning_keeps_zero_exit():
+    proc = _run_cli([
+        "--udfs", os.path.join(FLOWS_DIR, "dx303_udf_stale.json"),
+    ])
+    assert proc.returncode == 0, proc.stdout
+    assert "DX303" in proc.stdout
+
+
+def test_cli_udfs_json_matches_validate_endpoint():
+    """The REST ``udfs: true`` path and the CLI ``--udfs --json`` path
+    share one implementation — identical diagnostics AND identical
+    function summaries for the same flow JSON."""
+    from test_serve_jobs import FakeJobClient
+
+    from data_accelerator_tpu.serve.flowservice import FlowOperation
+    from data_accelerator_tpu.serve.restapi import DataXApi
+    from data_accelerator_tpu.serve.storage import (
+        LocalDesignTimeStorage,
+        LocalRuntimeStorage,
+    )
+
+    path = os.path.join(FLOWS_DIR, "dx301_udf_hostsync.json")
+    proc = _run_cli(["--udfs", "--json", path])
+    assert proc.returncode == 1  # DX301 is an error
+    cli_report = json.loads(proc.stdout)
+    assert cli_report["udfs"]["functions"]
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        api = DataXApi(FlowOperation(
+            LocalDesignTimeStorage(os.path.join(td, "design")),
+            LocalRuntimeStorage(os.path.join(td, "runtime")),
+            job_client=FakeJobClient(),
+        ))
+        status, out = api.dispatch(
+            "POST", "api/flow/validate",
+            body={"flow": load_flow("dx301_udf_hostsync"), "udfs": True},
+        )
+    assert status == 200
+    assert out["result"]["diagnostics"] == cli_report["diagnostics"]
+    assert out["result"]["udfs"] == cli_report["udfs"]
+    assert out["result"]["ok"] is False
+
+
+def test_validate_endpoint_all_three_tiers_merge():
+    """``device: true`` + ``udfs: true`` on one request: diagnostics
+    from all three tiers merge into one ordered list and both the
+    ``device`` cost report and the ``udfs`` summary ride along."""
+    from test_serve_jobs import FakeJobClient
+
+    from data_accelerator_tpu.serve.flowservice import FlowOperation
+    from data_accelerator_tpu.serve.restapi import DataXApi
+    from data_accelerator_tpu.serve.storage import (
+        LocalDesignTimeStorage,
+        LocalRuntimeStorage,
+    )
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        api = DataXApi(FlowOperation(
+            LocalDesignTimeStorage(os.path.join(td, "design")),
+            LocalRuntimeStorage(os.path.join(td, "runtime")),
+            job_client=FakeJobClient(),
+        ))
+        status, out = api.dispatch(
+            "POST", "api/flow/validate",
+            body={"flow": load_flow("dx303_udf_stale"),
+                  "device": True, "udfs": True},
+        )
+    assert status == 200
+    res = out["result"]
+    assert res["ok"] is True  # DX303 is a warning
+    assert "DX303" in [d["code"] for d in res["diagnostics"]]
+    assert res["device"]["stages"]
+    assert res["udfs"]["functions"][0]["name"] == "scalest"
 
 
 # ---------------------------------------------------------------------------

@@ -88,6 +88,38 @@ def test_restart_resumes_offsets_and_state(tmp_path):
     assert _state_map(host2) == {1: 9.0, 2: 7.0}
 
 
+def test_write_offsets_fsyncs_file_and_directory(tmp_path, monkeypatch):
+    """Satellite: the offsets checkpoint must survive POWER LOSS, not
+    just a process crash — the tmp file is fsynced before os.replace
+    and the directory entry is fsynced after it. Verified by recording
+    every fsync the write performs and mapping the fds back to their
+    paths."""
+    from data_accelerator_tpu.runtime.checkpoint import (
+        OffsetCheckpointer,
+        PartitionOffset,
+    )
+
+    synced = []
+    real_fsync = os.fsync
+
+    def spy_fsync(fd):
+        try:
+            synced.append(os.readlink(f"/proc/self/fd/{fd}"))
+        except OSError:
+            synced.append("<unknown>")
+        return real_fsync(fd)
+
+    monkeypatch.setattr(os, "fsync", spy_fsync)
+    ck = OffsetCheckpointer(str(tmp_path / "ck"))
+    ck.write_offsets([PartitionOffset(1, "default", 0, 0, 42)])
+    # the data file (still named .tmp when synced) and its directory
+    assert any(p.endswith("offsets.txt.tmp") for p in synced), synced
+    assert any(p.rstrip("/").endswith("ck") for p in synced), synced
+    # and the write still round-trips
+    assert ck.read_offsets() == [PartitionOffset(1, "default", 0, 0, 42)]
+    assert ck.starting_positions() == {("default", 0): 42}
+
+
 def test_backpressure_halves_rate_on_overrun(tmp_path, monkeypatch):
     _write_events(str(tmp_path / "in" / "a.json"), [{"k": 1, "v": 1.0}])
     host = StreamingHost(_conf(tmp_path, {

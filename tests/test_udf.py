@@ -265,6 +265,40 @@ class TestConfLoading:
         with pytest.raises(EngineException):
             load_udfs_from_conf(d)
 
+    def test_duplicate_name_across_tiers_rejected(self):
+        """Satellite: a name declared in BOTH the udf and udaf tiers
+        used to silently last-win (the udaf shadowed the udf); now the
+        loader rejects it with a typed EngineException."""
+        from data_accelerator_tpu.core.config import EngineException
+
+        d = SettingDictionary({
+            "datax.job.process.jar.udf.lastabove.class":
+                "data_accelerator_tpu.udf.samples:scaleby",
+            "datax.job.process.jar.udaf.lastabove.class":
+                "data_accelerator_tpu.udf.samples:lastabove",
+        })
+        with pytest.raises(EngineException, match="duplicate UDF name"):
+            load_udfs_from_conf(d)
+
+    def test_builtin_shadowing_rejected(self):
+        """Satellite: a UDF named like an engine builtin (CONCAT, AVG,
+        ...) would never be called — the compiler resolves builtins
+        first — so registration fails instead of silently no-opping."""
+        from data_accelerator_tpu.core.config import EngineException
+
+        d = SettingDictionary({
+            "datax.job.process.jar.udf.concat.class":
+                "data_accelerator_tpu.udf.samples:scaleby",
+        })
+        with pytest.raises(EngineException, match="shadows the engine builtin"):
+            load_udfs_from_conf(d)
+        d2 = SettingDictionary({
+            "datax.job.process.jar.udaf.avg.class":
+                "data_accelerator_tpu.udf.samples:lastabove",
+        })
+        with pytest.raises(EngineException, match="shadows the engine builtin"):
+            load_udfs_from_conf(d2)
+
 
 class TestExternalFunctionSink:
     def test_rows_posted_per_event(self):

@@ -1,0 +1,359 @@
+"""Runtime ground truth for the DX3xx UDF analyzer tier.
+
+One pair of tests per code: the flagged (``bad``) UDF from the golden
+fixture module really DOES raise / retrace / desync under ``jax.jit``,
+and its ``clean`` twin computes the same job while tracing exactly
+once — so the analyzer's verdicts can never drift from what the tracer
+actually rejects. (The golden-fixture analyzer tests themselves live
+in tests/test_analysis.py ``UDF_GOLDEN``.)
+
+Plus the runtime counterpart: the ``process.debug`` sanitizer conf
+block (jax.debug_nans + tracer-leak checking) on the processor and on
+LiveQuery kernels.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from data_accelerator_tpu.core.config import SettingDictionary
+from data_accelerator_tpu.runtime.processor import FlowProcessor
+from data_accelerator_tpu.udf.api import JaxUdf
+
+from data.udfs import (  # noqa: F401 — fixture package
+    dx300_branch,
+    dx301_hostsync,
+    dx302_impure,
+    dx303_stale,
+    dx304_outtype,
+    dx305_pallas,
+    dx310_notaggregate,
+)
+
+SCHEMA = json.dumps({
+    "type": "struct",
+    "fields": [
+        {"name": "deviceId", "type": "long", "nullable": False,
+         "metadata": {}},
+        {"name": "temperature", "type": "double", "nullable": False,
+         "metadata": {}},
+    ],
+})
+
+X = jnp.asarray(np.arange(1.0, 9.0), jnp.float32)
+Y = jnp.asarray(np.arange(2.0, 10.0), jnp.float32)
+
+
+def assert_traces_once(fn, *calls):
+    """The clean-twin contract: same-shape calls share ONE trace."""
+    jitted = jax.jit(fn)
+    outs = [jitted(c) for c in calls]
+    assert jitted._cache_size() == 1
+    return outs
+
+
+def make_proc(transform, udfs=None, conf_extra=None, capacity=64):
+    conf = {
+        "datax.job.name": "UdfCheckRt",
+        "datax.job.input.default.inputtype": "local",
+        "datax.job.input.default.blobschemafile": SCHEMA,
+        "datax.job.process.transform": transform,
+        "datax.job.process.projection": "Raw.*",
+    }
+    conf.update(conf_extra or {})
+    return FlowProcessor(
+        SettingDictionary(conf), udfs=udfs, batch_capacity=capacity,
+        output_datasets=["T"],
+    )
+
+
+def feed(proc, device_ids, temps, batch_time_ms=1_700_000_000_000):
+    cap = proc.batch_capacity
+    cols = {
+        "deviceId": np.zeros(cap, np.int32),
+        "temperature": np.zeros(cap, np.float32),
+    }
+    n = len(device_ids)
+    cols["deviceId"][:n] = device_ids
+    cols["temperature"][:n] = temps
+    raw = proc.encode_columns(cols, n)
+    return proc.process_batch(raw, batch_time_ms=batch_time_ms)
+
+
+# ---------------------------------------------------------------------------
+# DX300: tracer in Python control flow -> TracerBoolConversionError
+# ---------------------------------------------------------------------------
+class TestDX300GroundTruth:
+    def test_bad_raises_under_jit(self):
+        with pytest.raises(jax.errors.TracerBoolConversionError):
+            jax.jit(dx300_branch.bad().fn)(X)
+
+    def test_clean_twin_traces_once(self):
+        outs = assert_traces_once(dx300_branch.clean().fn, X, Y)
+        np.testing.assert_allclose(np.asarray(outs[0]), np.asarray(X))
+
+
+# ---------------------------------------------------------------------------
+# DX301: host sync point -> ConcretizationTypeError
+# ---------------------------------------------------------------------------
+class TestDX301GroundTruth:
+    def test_bad_raises_under_jit(self):
+        with pytest.raises(jax.errors.ConcretizationTypeError):
+            jax.jit(dx301_hostsync.bad().fn)(X)
+
+    def test_clean_twin_traces_once(self):
+        outs = assert_traces_once(dx301_hostsync.clean().fn, X, Y)
+        np.testing.assert_allclose(
+            np.asarray(outs[0]), np.asarray(X) * float(X[0])
+        )
+
+
+# ---------------------------------------------------------------------------
+# DX302: impurity -> side effect runs once at trace time, then never
+# ---------------------------------------------------------------------------
+class TestDX302GroundTruth:
+    def test_bad_side_effect_desyncs_under_jit(self):
+        dx302_impure.CALLS.clear()
+        jitted = jax.jit(dx302_impure.bad().fn)
+        for _ in range(3):
+            jitted(X).block_until_ready()
+        # three batches, ONE append: the mutation happened at trace
+        # time only — eager execution would have appended three times
+        assert len(dx302_impure.CALLS) == 1
+        dx302_impure.CALLS.clear()
+
+    def test_clean_twin_traces_once(self):
+        outs = assert_traces_once(dx302_impure.clean().fn, X, Y)
+        np.testing.assert_allclose(np.asarray(outs[0]), np.asarray(X) * 2.0)
+
+
+# ---------------------------------------------------------------------------
+# DX303: stale captured state — updates after trace silently ignored
+# ---------------------------------------------------------------------------
+class TestDX303GroundTruth:
+    def test_bad_serves_stale_state_under_jit(self):
+        udf = dx303_stale.bad()
+        cells = dict(zip(udf.fn.__code__.co_freevars, udf.fn.__closure__))
+        state = cells["state"].cell_contents
+        jitted = jax.jit(udf.fn)
+        np.testing.assert_allclose(
+            np.asarray(jitted(X)), np.asarray(X) * 2.0
+        )
+        state["factor"] = 5.0  # no on_interval -> nobody re-traces
+        np.testing.assert_allclose(
+            np.asarray(jitted(X)), np.asarray(X) * 2.0  # STALE
+        )
+
+    def test_clean_twin_traces_once_and_refresh_retraces(self):
+        # the declared on_interval is the fix: the processor re-traces
+        # on a True refresh (see test_udf.py
+        # test_interval_state_change_retraces_step for the full loop)
+        udf = dx303_stale.clean()
+        assert udf.on_interval(0) is False
+        assert_traces_once(udf.fn, X, Y)
+
+
+# ---------------------------------------------------------------------------
+# DX304: out_type lie — pipeline decodes through the wrong column type
+# ---------------------------------------------------------------------------
+class TestDX304GroundTruth:
+    def test_bad_truncates_through_pipeline(self):
+        proc = make_proc(
+            "--DataXQuery--\n"
+            "T = SELECT halfit(temperature) AS h FROM DataXProcessedInput",
+            udfs={"halfit": dx304_outtype.bad()},
+        )
+        datasets, _ = feed(proc, [1], [5.0])
+        true_value = float(dx304_outtype._half(jnp.asarray([5.0]))[0])
+        assert true_value == 2.5
+        # declared long: the 2.5 the function computes decodes as 2
+        assert datasets["T"][0]["h"] == 2
+
+    def test_clean_twin_preserves_value(self):
+        proc = make_proc(
+            "--DataXQuery--\n"
+            "T = SELECT halfit(temperature) AS h FROM DataXProcessedInput",
+            udfs={"halfit": dx304_outtype.clean()},
+        )
+        datasets, _ = feed(proc, [1], [5.0])
+        assert datasets["T"][0]["h"] == 2.5
+        assert_traces_once(dx304_outtype.clean().fn, X, Y)
+
+
+# ---------------------------------------------------------------------------
+# DX305: pallas hazards — bad cannot lower, clean runs
+# ---------------------------------------------------------------------------
+class TestDX305GroundTruth:
+    def test_bad_raises_under_jit(self):
+        # missing out_shape (and a traced grid): pallas_call cannot
+        # even be invoked
+        with pytest.raises((TypeError, jax.errors.JAXTypeError)):
+            jax.jit(dx305_pallas.bad().fn)(X)
+
+    def test_clean_twin_traces_once(self):
+        outs = assert_traces_once(dx305_pallas.clean().fn, X, Y)
+        np.testing.assert_allclose(np.asarray(outs[0]), np.asarray(X) * 2.0)
+
+
+# ---------------------------------------------------------------------------
+# DX310: a scalar UDF declared as an aggregate never aggregates
+# ---------------------------------------------------------------------------
+class TestDX310GroundTruth:
+    Q = (
+        "--DataXQuery--\n"
+        "T = SELECT deviceId, lastval(temperature) AS l "
+        "FROM DataXProcessedInput GROUP BY deviceId"
+    )
+
+    def _run(self, attr):
+        proc = make_proc(self.Q, conf_extra={
+            "datax.job.process.jar.udaf.lastval.class":
+                f"tests.data.udfs.dx310_notaggregate:{attr}",
+        })
+        datasets, _ = feed(proc, [1, 1, 2], [3.0, 9.0, 5.0])
+        return {r["deviceId"]: r["l"] for r in datasets["T"]}
+
+    def test_bad_silently_does_not_aggregate(self):
+        # group 1 holds {3.0, 9.0}; the fake aggregate returns the
+        # first row's value instead of the max — silent wrong answers
+        assert self._run("bad") == {1: 3.0, 2: 5.0}
+
+    def test_clean_twin_aggregates(self):
+        assert self._run("clean") == {1: 9.0, 2: 5.0}
+
+    def test_unloadable_conf_entry_raises(self):
+        from data_accelerator_tpu.core.config import EngineException
+        from data_accelerator_tpu.udf.api import load_udfs_from_conf
+
+        with pytest.raises(EngineException):
+            load_udfs_from_conf(SettingDictionary({
+                "datax.job.process.jar.udf.ghost.class":
+                    "tests.data.udfs.no_such_module:bad",
+            }))
+
+
+# ---------------------------------------------------------------------------
+# sanitizer wiring: the process.debug conf block (runtime counterpart)
+# ---------------------------------------------------------------------------
+NANNY = JaxUdf(
+    "nanny", lambda x: jnp.log(x.astype(jnp.float32) - 100.0),
+    out_type="double",
+)
+
+LEAKED = []
+
+
+def _leak_fn(x):
+    LEAKED.append(x)  # a tracer escapes the traced step
+    return x.astype(jnp.float32) * 1.0
+
+
+class TestDebugSanitizers:
+    Q = (
+        "--DataXQuery--\n"
+        "T = SELECT nanny(temperature) AS n FROM DataXProcessedInput"
+    )
+
+    def test_debug_nans_off_is_silent(self):
+        proc = make_proc(self.Q, udfs={"nanny": NANNY})
+        datasets, _ = feed(proc, [1], [5.0])  # log(-95) -> NaN, silently
+        assert np.isnan(datasets["T"][0]["n"])
+
+    def test_debug_nans_raises_loudly(self):
+        proc = make_proc(self.Q, udfs={"nanny": NANNY}, conf_extra={
+            "datax.job.process.debug.nans": "true",
+        })
+        assert proc.debug_nans
+        with pytest.raises(FloatingPointError):
+            feed(proc, [1], [5.0])
+
+    def test_debug_tracer_leaks_raises_loudly(self):
+        LEAKED.clear()
+        leaker = JaxUdf("leaker", _leak_fn, out_type="double")
+        q = ("--DataXQuery--\n"
+             "T = SELECT leaker(temperature) AS v FROM DataXProcessedInput")
+        proc = make_proc(q, udfs={"leaker": leaker}, conf_extra={
+            "datax.job.process.debug.tracerleaks": "true",
+        })
+        assert proc.debug_tracer_leaks
+        with pytest.raises(Exception, match="[Ll]eak"):
+            feed(proc, [1], [5.0])
+        LEAKED.clear()
+        # the same impure UDF sails through silently without the flag
+        proc2 = make_proc(q, udfs={"leaker": leaker})
+        datasets, _ = feed(proc2, [1], [5.0])
+        assert datasets["T"][0]["v"] == 5.0
+        LEAKED.clear()
+
+    def test_livequery_kernel_debug_flag(self):
+        from data_accelerator_tpu.serve.livequery import KernelService
+
+        rows = [{"deviceId": 1, "temperature": 5.0}]
+        svc = KernelService()
+        kid = svc.create_kernel(
+            "DbgFlow", SCHEMA, sample_rows=rows,
+            udfs={"nanny": NANNY}, debug=True,
+        )
+        with pytest.raises(FloatingPointError):
+            svc.execute(
+                kid,
+                "S = SELECT nanny(temperature) AS n "
+                "FROM DataXProcessedInput",
+            )
+        # without debug the same kernel query returns the NaN silently
+        kid2 = svc.create_kernel(
+            "DbgFlow", SCHEMA, sample_rows=rows, udfs={"nanny": NANNY},
+        )
+        out = svc.execute(
+            kid2,
+            "S = SELECT nanny(temperature) AS n FROM DataXProcessedInput",
+        )
+        assert np.isnan(out["result"][0]["n"])
+
+
+# ---------------------------------------------------------------------------
+# a throwing on_interval: batch loop survives, metric counts it
+# ---------------------------------------------------------------------------
+class TestUdfRefreshErrorIsolation:
+    def test_refresh_error_skipped_and_metered(self):
+        calls = []
+
+        def exploding(ts):
+            calls.append(ts)
+            raise RuntimeError("refresh backend down")
+
+        udf = JaxUdf(
+            "scale2", lambda x: x.astype(jnp.float32) * 2.0,
+            out_type="double", on_interval=exploding,
+        )
+        proc = make_proc(
+            "--DataXQuery--\n"
+            "T = SELECT scale2(temperature) AS s FROM DataXProcessedInput",
+            udfs={"scale2": udf},
+        )
+        d1, m1 = feed(proc, [1], [3.0])
+        assert d1["T"][0]["s"] == 6.0  # previous trace kept serving
+        assert m1["UdfRefreshError"] == 1.0
+        d2, m2 = feed(proc, [1], [4.0])
+        assert d2["T"][0]["s"] == 8.0
+        assert m2["UdfRefreshError"] == 1.0  # drained per collect
+        assert len(calls) == 2  # the hook ran (and threw) each batch
+
+    def test_registry_records_error_names(self):
+        from data_accelerator_tpu.udf.api import UdfRegistry
+
+        ok_calls = []
+        boom = JaxUdf("boom", lambda x: x, out_type="double",
+                      on_interval=lambda ts: (_ for _ in ()).throw(
+                          ValueError("nope")))
+        fine = JaxUdf("fine", lambda x: x, out_type="double",
+                      on_interval=lambda ts: (ok_calls.append(ts), True)[1])
+        reg = UdfRegistry({"boom": boom, "fine": fine})
+        # the healthy hook still drives a re-trace; the throwing one is
+        # isolated and named
+        assert reg.refresh(123) is True
+        assert reg.last_errors == ["boom"]
+        assert ok_calls == [123]
