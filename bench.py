@@ -12,11 +12,17 @@ Prints ONE JSON line:
 Reported figures:
 - value / vs_baseline: ingest-inclusive events/s/chip vs the north-star
   per-chip share (1M ev/s on a v5e-16 => 62,500 ev/s/chip). The
-  throughput loop is pipelined like StreamingHost.run_pipelined (decode
-  of batch N+1 overlaps batch N's device step + result transport) and
-  runs `BENCH_RUNS` times; value is the MEDIAN, with min/max alongside,
-  so one tunnel-weather run can't swing the headline (r3->r4 showed
-  -13% on identical code from environment variance alone).
+  throughput loop is pipelined like StreamingHost.run_pipelined with a
+  depth-N in-flight window (BENCH_PIPELINE_DEPTH, default = conf
+  process.pipeline.depth = 2; decode of batch N+1 overlaps the window's
+  device steps + result transport) and runs `BENCH_RUNS` times; value
+  is the MEDIAN, with min/max alongside, so one tunnel-weather run
+  can't swing the headline (r3->r4 showed -13% on identical code from
+  environment variance alone). `depth_sweep_events_per_sec` re-runs the
+  loop once per depth in {1, 2, 4}; `pipeline_depth`,
+  `d2h_bytes_per_batch` and `transfer_efficiency` report the headline
+  depth and what sized output transfer moved vs the padded capacity
+  (`hbm_model.d2h_full_fetch_bytes` is the un-sized comparison point).
 - p99_rule_eval_ms: per-batch end-to-end latency in a small-batch
   (8192-row) SEQUENTIAL loop — ingest decode to results materialized on
   host. (Earlier rounds measured this inside the pipelined loop, where
@@ -108,15 +114,24 @@ def bench_decoder(proc, payload, n_rows, iters=8):
     return n_rows / t, len(payload) / t / 1e6
 
 
-def pipelined_ingest_loop(proc, payloads, iters, base_ms, hist):
+def pipelined_ingest_loop(proc, payloads, iters, base_ms, hist,
+                          depth=None, transfer_stats=None):
     """The production throughput shape (StreamingHost.run_pipelined):
     a decode-ahead worker thread parses batch N+1's JSON (the C++
     decoder releases the GIL) while the main thread dispatches batch N
-    and collects N-1 — so host decode overlaps device compute AND
-    result transport. Returns events/s; per-batch t0->collected ms (t0
-    BEFORE the decode, so ingest-inclusive) lands in ``hist`` under the
-    streaming host's whole-batch stage name."""
+    and holds up to ``depth`` batches in flight (conf
+    process.pipeline.depth, default 2), collecting the oldest FIFO — so
+    host decode overlaps device compute AND result transport across the
+    window. Returns events/s; per-batch t0->collected ms (t0 BEFORE the
+    decode, so ingest-inclusive) lands in ``hist`` under the streaming
+    host's whole-batch stage name; per-batch Transfer_* metrics land in
+    ``transfer_stats`` when given."""
+    from collections import deque
     from concurrent.futures import ThreadPoolExecutor
+
+    if depth is None:
+        depth = proc.pipeline_depth
+    depth = max(1, depth)
 
     def decode(i):
         t0 = time.perf_counter()
@@ -126,7 +141,24 @@ def pipelined_ingest_loop(proc, payloads, iters, base_ms, hist):
         )
         return raw, t0
 
-    pending = None  # (handle, t0)
+    pending = deque()  # FIFO window of (handle, t0)
+
+    def collect_oldest():
+        ph, pt0 = pending.popleft()
+        _d, m = ph.collect()
+        hist.observe(
+            BENCH_FLOW, "batch", (time.perf_counter() - pt0) * 1000.0
+        )
+        if transfer_stats is not None:
+            if "Transfer_D2HBytes" in m:
+                transfer_stats.setdefault("d2h_bytes", []).append(
+                    m["Transfer_D2HBytes"]
+                )
+            if "Transfer_Efficiency" in m:
+                transfer_stats.setdefault("efficiency", []).append(
+                    m["Transfer_Efficiency"]
+                )
+
     pool = ThreadPoolExecutor(1)
     try:
         t_start = time.perf_counter()
@@ -139,16 +171,11 @@ def pipelined_ingest_loop(proc, payloads, iters, base_ms, hist):
             handle = proc.dispatch_batch(
                 raw, batch_time_ms=base_ms + i * 1000
             )
-            if pending is not None:
-                ph, pt0 = pending
-                ph.collect()
-                hist.observe(
-                    BENCH_FLOW, "batch", (time.perf_counter() - pt0) * 1000.0
-                )
-            pending = (handle, t0)
-        ph, pt0 = pending
-        ph.collect()
-        hist.observe(BENCH_FLOW, "batch", (time.perf_counter() - pt0) * 1000.0)
+            pending.append((handle, t0))
+            if len(pending) > depth:
+                collect_oldest()
+        while pending:
+            collect_oldest()
         total_s = time.perf_counter() - t_start
     finally:
         pool.shutdown(wait=False, cancel_futures=True)
@@ -235,6 +262,10 @@ def hbm_model_check(proc):
         "lowered_hbm_bytes": lowered,
         "hbm_model_error": round(err, 4),
         "ici_bytes_per_batch_16chip": report.totals()["iciBytesPerBatch"],
+        # modeled FULL-capacity D2H cost of the outputs — compare with
+        # the measured d2h_bytes_per_batch to see what sized transfer
+        # saves on the wire
+        "d2h_full_fetch_bytes": report.totals()["d2hBytesPerBatch"],
         "stages": len(report.stages),
     }
 
@@ -283,20 +314,48 @@ def main():
 
     # -- throughput: ingest-inclusive pipelined loop, multi-run ----------
     proc = build_processor(capacity)
+    depth = int(os.environ.get(
+        "BENCH_PIPELINE_DEPTH", str(proc.pipeline_depth)
+    ))
     payloads = [
         make_json_payload(proc, capacity, seed=3 + j) for j in range(2)
     ]
     dec_rows_s, dec_mb_s = bench_decoder(proc, payloads[0], capacity)
+    # warmup also seeds the sized-transfer EWMA, so the measured loops
+    # run with adaptive D2H capacities like a warmed production host
     for i in range(warmup):
         raw = proc.encode_json_bytes(payloads[0], base_ms - 60_000 + i * 1000)
         proc.process_batch(raw, batch_time_ms=base_ms - 60_000 + i * 1000)
     run_eps = []
+    transfer_stats = {}
     for r in range(runs):
         run_eps.append(pipelined_ingest_loop(
-            proc, payloads, iters, base_ms + r * 120_000, hist
+            proc, payloads, iters, base_ms + r * 120_000, hist,
+            depth=depth, transfer_stats=transfer_stats,
         ))
     eps = float(np.median(run_eps))
     p99_batch = hist.percentile(BENCH_FLOW, "batch", 99)
+    d2h_bytes = (
+        float(np.median(transfer_stats["d2h_bytes"]))
+        if transfer_stats.get("d2h_bytes") else None
+    )
+    transfer_eff = (
+        float(np.median(transfer_stats["efficiency"]))
+        if transfer_stats.get("efficiency") else None
+    )
+
+    # -- depth sweep: one run per non-headline depth, scratch histograms,
+    # so the BENCH_* trajectory can attribute sync-stage/overlap deltas
+    depth_sweep = {str(depth): round(eps, 1)}
+    if os.environ.get("BENCH_DEPTH_SWEEP", "1") != "0":
+        for d in (1, 2, 4):
+            if d == depth:
+                continue
+            scratch = HistogramRegistry()
+            depth_sweep[str(d)] = round(pipelined_ingest_loop(
+                proc, payloads, iters, base_ms + 600_000 + d * 120_000,
+                scratch, depth=d,
+            ), 1)
 
     # -- latency mode: small batches, sequential, with stage breakdown ---
     lat_cap = int(os.environ.get("BENCH_LATENCY_CAPACITY", "8192"))
@@ -341,6 +400,14 @@ def main():
         "eps_min": round(min(run_eps), 1),
         "eps_max": round(max(run_eps), 1),
         "p99_batch_ms": round(p99_batch, 2),
+        "pipeline_depth": depth,
+        "depth_sweep_events_per_sec": depth_sweep,
+        "d2h_bytes_per_batch": (
+            round(d2h_bytes, 1) if d2h_bytes is not None else None
+        ),
+        "transfer_efficiency": (
+            round(transfer_eff, 4) if transfer_eff is not None else None
+        ),
         "p99_rule_eval_ms": round(p99_rule, 2),
         "p99_rule_compute_ms": round(p99_compute, 2),
         "p99_engine_ms": round(p99_engine, 2),

@@ -81,6 +81,21 @@ def view_output_bytes(
     return b
 
 
+def d2h_transfer_bytes(
+    types: Dict[str, str], plan: Optional[StagePlan], rows_transferred: int
+) -> int:
+    """Closed-form device->host bytes of fetching one OUTPUT table at
+    ``rows_transferred`` rows — the per-batch wire cost of the sync
+    stage for that output. The transferred table has exactly the
+    view-output layout (schema columns + overflow slots + validity), so
+    the term is ``view_output_bytes`` evaluated at the transfer
+    capacity: the full padded capacity for a plain fetch, or the sized
+    (EWMA-bucketed) capacity under
+    ``datax.job.process.pipeline.sizedtransfer``. See ANALYSIS.md
+    "Scaling model" and the DX206 hint."""
+    return view_output_bytes(types, plan, rows_transferred)
+
+
 def _log2(n: int) -> float:
     return math.log2(max(int(n), 2))
 

@@ -56,6 +56,7 @@ from ..runtime.timewindow import num_slots
 from ..serve.flowbuilder import RuleDefinitionGenerator
 from .costmodel import (
     DEFAULT_MATCH_MATRIX_BUDGET,
+    d2h_transfer_bytes,
     row_bytes,
     stage_flops,
     stage_ici_bytes,
@@ -72,6 +73,11 @@ DEFAULT_CHIPS = 16
 # fires when retention crosses a quarter of it
 INT32_MS_HORIZON = 2 ** 31
 REBASE_PROXIMITY_FRACTION = 0.25
+
+# DX206 fires when an OUTPUT view's static capacity exceeds the modeled
+# row count (declared group-key cardinality) by this factor — the sync
+# stage would transfer >98% padding on a full-capacity fetch
+D2H_OVERSIZE_FACTOR = 64
 
 _STRUCT_DTYPES = {"double": jnp.float32, "boolean": jnp.bool_}
 
@@ -119,6 +125,9 @@ class StageCost:
     transient_bytes: int = 0  # peak in-stage intermediates (match matrix)
     flops: float = 0.0
     ici_bytes: float = 0.0  # expected interconnect bytes/batch at `chips`
+    # device->host bytes a full-capacity fetch of this stage moves per
+    # batch — non-zero only for OUTPUT views (the sync-stage wire cost)
+    d2h_bytes: int = 0
     detail: str = ""
 
     def to_dict(self) -> dict:
@@ -131,6 +140,7 @@ class StageCost:
             "transientBytes": self.transient_bytes,
             "flops": round(self.flops, 1),
             "iciBytes": round(self.ici_bytes, 1),
+            "d2hBytes": self.d2h_bytes,
             "detail": self.detail,
         }
 
@@ -171,6 +181,7 @@ class DevicePlanReport:
             "iciBytesPerBatch": round(
                 sum(s.ici_bytes for s in self.stages), 1
             ),
+            "d2hBytesPerBatch": sum(s.d2h_bytes for s in self.stages),
         }
 
     def plan_dict(self) -> dict:
@@ -257,6 +268,9 @@ class FlowDevicePlan:
     watermark_s: float = 0.0
     interval_s: float = 1.0
     chips: int = DEFAULT_CHIPS
+    # datasets routed to sinks — the views whose tables cross the
+    # device->host boundary every batch (the D2H term + DX206 surface)
+    output_datasets: List[str] = field(default_factory=list)
 
 
 def _declared_cardinality(schema: Schema) -> Tuple[Dict[str, int], int]:
@@ -511,6 +525,15 @@ def _plan_from_gui(
         if getattr(u, "_on_interval", None) is not None
     ]
 
+    # OUTPUT statements name the datasets that cross D2H every batch
+    view_names = {v.name for v in pipeline.views}
+    out_datasets: List[str] = []
+    for tables, _sink in rc.outputs:
+        for t in tables.split(","):
+            t = t.strip()
+            if t in view_names and t not in out_datasets:
+                out_datasets.append(t)
+
     return FlowDevicePlan(
         name=name,
         pipeline=pipeline,
@@ -534,6 +557,7 @@ def _plan_from_gui(
         chips=chips
         or _jobconf_int(jobconf, "jobNumChips", "jobNumExecutors")
         or DEFAULT_CHIPS,
+        output_datasets=out_datasets,
     )
 
 
@@ -586,6 +610,7 @@ def flow_plan_from_processor(proc, chips: Optional[int] = None) -> FlowDevicePla
         watermark_s=proc.watermark_s,
         interval_s=proc.interval_s,
         chips=chips or conf_chips or DEFAULT_CHIPS,
+        output_datasets=list(proc.output_datasets),
     )
 
 
@@ -715,9 +740,16 @@ def _stage_walk(
     for view in plan.pipeline.views:
         out = eval_view(view, env)
         env[view.name] = out
-        stages.append(_view_stage(
+        stage = _view_stage(
             view, _table_data_bytes(out), plan, plan.pipeline.catalog
-        ))
+        )
+        if view.name in plan.output_datasets:
+            # the sync-stage wire cost: a full-capacity fetch of this
+            # output's table crosses the device->host boundary per batch
+            stage.d2h_bytes = d2h_transfer_bytes(
+                view.schema.types, view.plan, view.capacity
+            )
+        stages.append(stage)
     return stages
 
 
@@ -775,6 +807,24 @@ def _lint(
                         f"capacity is {p.groups_bound} (process.maxgroups); "
                         f"overflow groups drop and surface only as "
                         f"Output_{view.name}_GroupsDropped",
+                    ))
+                elif (
+                    view.name in plan.output_datasets
+                    and view.capacity > D2H_OVERSIZE_FACTOR * product
+                ):
+                    per_batch = d2h_transfer_bytes(
+                        view.schema.types, p, view.capacity
+                    )
+                    diags.append(make(
+                        "DX206", view.name,
+                        f"output capacity {view.capacity} exceeds the "
+                        f"modeled group count {product} by more than "
+                        f"{D2H_OVERSIZE_FACTOR}x: a full fetch moves "
+                        f"{per_batch} D2H bytes/batch of mostly padding "
+                        f"through the sync stage; sized output transfer "
+                        f"(process.pipeline.sizedtransfer, default on) "
+                        f"or a tighter process.maxgroups shrinks it to "
+                        f"the wire minimum",
                     ))
         for s in p.joins:
             if s.out_rows < s.left_rows:

@@ -135,6 +135,8 @@ CODES: Dict[str, tuple] = {
               "bound the dictionary (process.stringdictionary.maxsize) and keep UDF refresh intervals coarse"),
     "DX205": (SEV_WARNING, "window retention approaches the int32 ring-rebase horizon (~24.8 days of relative millis)",
               "shorten the window/watermark well below a quarter of the 2^31 ms horizon"),
+    "DX206": (SEV_WARNING, "output capacity exceeds the modeled row count by >64x: the sync stage transfers mostly padding device->host",
+              "keep sized output transfer on (process.pipeline.sizedtransfer) or tighten process.maxgroups toward the modeled cardinality"),
     "DX290": (SEV_ERROR, "flow fails device lowering: the planner rejected a statement the runtime would also reject",
               "fix the statement per the planner's message (it is the production compiler's own error)"),
     "DX291": (SEV_WARNING, "device analysis unavailable: no concrete input schema or design-time-unloadable UDF",

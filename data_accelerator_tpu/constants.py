@@ -104,6 +104,17 @@ class MetricName:
         # UDF on_interval hooks that threw (refresh skipped, previous
         # trace kept serving — runtime/processor.py dispatch_batch)
         r"UdfRefreshError",
+        # depth-N pipelined window (runtime/host.py run_pipelined):
+        # in-flight depth at finish time + ms the dispatch loop stalled
+        # waiting for the window's oldest batch
+        r"Pipeline_Depth",
+        r"Pipeline_Stall_Ms",
+        # sized output transfer (runtime/processor.py PendingBatch):
+        # D2H bytes per batch, valid/transferred row ratio, and the
+        # async-copy-capability / sized-cap-overflow fallback counters
+        r"Transfer_D2HBytes",
+        r"Transfer_Efficiency",
+        r"Transfer_(AsyncCopyFallback|Overflow)_Count",
     )
 
     @classmethod

@@ -184,16 +184,22 @@ class BatchHost:
 
         reference: BlobBatchingHost.runBatchApp:70-110 — one processor
         pass over the listed files; here the fixed device batch shape
-        chunks the row stream, same compiled step per chunk.
+        chunks the row stream, same compiled step per chunk. Up to
+        ``process.pipeline.depth`` chunks stay in flight (the
+        generalized P6 overlap shared with
+        ``StreamingHost.run_pipelined``); finishes are strictly FIFO so
+        state-table commits happen in chunk order.
         """
+        from collections import deque
+
         self.telemetry.track_event("datax/batch/app/begin")
         t0 = time.time()
         files = self.list_files_to_process()
         cap = self.processor.batch_capacity
+        depth = max(1, self.processor.pipeline_depth)
         totals: Dict[str, float] = {"Batch_Files_Count": float(len(files))}
-        rows: List[dict] = []
         batch_time_ms = int(t0 * 1000)
-        pending = None  # one (handle, trace) in flight (P6 overlap)
+        pending = deque()  # FIFO window of (handle, trace) in flight
 
         def finish(handle, trace) -> None:
             with trace.activate():
@@ -211,15 +217,15 @@ class BatchHost:
                 # latency values don't (a pipelined chunk's
                 # dispatch->collect span absorbs the NEXT chunk's file
                 # reads, and summing an epoch timestamp is meaningless)
-                if k in ("Latency-Process", "BatchProcessedET"):
+                if k in ("Latency-Process", "BatchProcessedET",
+                         "Transfer_Efficiency", "Pipeline_Depth"):
                     continue
                 totals[k] = totals.get(k, 0.0) + float(v)
 
         def flush(chunk: List[dict]):
-            # dispatch chunk N, then finish chunk N-1 while N computes —
-            # same overlap as StreamingHost.run_pipelined, so file reads
-            # and sink writes hide under the device step
-            nonlocal pending
+            # dispatch chunk N; once `depth` chunks are in flight,
+            # finish the oldest while the newer ones compute — file
+            # reads and sink writes hide under the device steps
             trace = self.tracer.begin("batch/chunk", batchTime=batch_time_ms)
             with trace.activate(), tracing.span("decode", rows=len(chunk)):
                 raw = self.processor.encode_rows(
@@ -228,25 +234,34 @@ class BatchHost:
             with trace.activate(), tracing.span("dispatch"):
                 handle = self.processor.dispatch_batch(raw, batch_time_ms)
             trace.mark("dispatch-done")
-            if pending is not None:
-                finish(*pending)
-            pending = (handle, trace)
+            pending.append((handle, trace))
+            if len(pending) > depth:
+                finish(*pending.popleft())
 
+        # linear row buffering: consume via an index instead of
+        # re-slicing the tail each chunk (`rows = rows[cap:]` re-copied
+        # everything after the cut, O(n^2) over a multi-million-row
+        # file set); the buffer compacts only when the dead prefix
+        # dominates, keeping the whole pass amortized O(n)
+        rows: List[dict] = []
+        pos = 0
         try:
             for f in files:
                 rows.extend(read_json_file(f))
-                while len(rows) >= cap:
-                    flush(rows[:cap])
-                    rows = rows[cap:]
-            if rows:
-                flush(rows)
-            if pending is not None:
-                finish(*pending)
-                pending = None
+                while len(rows) - pos >= cap:
+                    flush(rows[pos:pos + cap])
+                    pos += cap
+                    if pos >= cap and pos * 2 >= len(rows):
+                        del rows[:pos]
+                        pos = 0
+            if len(rows) > pos:
+                flush(rows[pos:])
+            while pending:
+                finish(*pending.popleft())
         except Exception as e:
             self.telemetry.track_exception(e, {"event": "error/batch/process"})
-            if pending is not None:
-                pending[1].end(status="error")  # idempotent
+            for _h, tr in pending:
+                tr.end(status="error")  # idempotent
             raise
         # tracker written only after a fully successful pass (at-least-once)
         self._processed.update(files)

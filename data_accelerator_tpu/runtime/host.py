@@ -219,19 +219,30 @@ class StreamingHost:
             consumed.update(c)
         return raw, consumed, batch_time_ms, t0
 
-    def _finish(self, handle, consumed, batch_time_ms, t0, trace) -> Dict[str, float]:
+    def _finish(
+        self, handle, consumed, batch_time_ms, t0, trace,
+        inflight_depth: int = 1,
+    ) -> Dict[str, float]:
         """Collect a batch and run its tail: sinks -> commit -> ack ->
         metrics -> checkpoint. Failures requeue un-acked source batches
         and rethrow so the batch retries, at-least-once
         (CommonProcessorFactory.scala:382-398). Every stage is a span of
-        the batch's trace and a sample in its stage histogram."""
+        the batch's trace and a sample in its stage histogram.
+        ``inflight_depth``: how many batches (this one included) were in
+        flight when the window forced this finish — the live pipeline
+        depth gauge."""
+        stall_ms = 0.0
         try:
             with trace.activate():
                 with tracing.span("sync"):
                     # completion handshake first, so the trace separates
                     # "rules evaluated" (device-step ends here) from
                     # result transport + materialization (collect)
+                    sync_t0 = time.time()
                     handle.block_until_evaluated()
+                    # time the dispatch loop actually stalled waiting
+                    # for the window's oldest batch to leave the device
+                    stall_ms = (time.time() - sync_t0) * 1000.0
                 trace.record_since("device-step", "dispatch-done")
                 with tracing.span("collect"):
                     datasets, metrics = handle.collect()
@@ -255,6 +266,8 @@ class StreamingHost:
 
         metrics["Latency-Batch"] = (time.time() - t0) * 1000.0
         metrics["IngestRateScale"] = self._rate_scale
+        metrics["Pipeline_Depth"] = float(inflight_depth)
+        metrics["Pipeline_Stall_Ms"] = stall_ms
         # per-stage latency percentiles from the live histograms — the
         # DATAX-<flow>:Latency-<Stage>-pNN series the dashboard's stat
         # tiles and stage timechart read (obs/histogram.py keeps these
@@ -388,23 +401,42 @@ class StreamingHost:
         finally:
             self._stop_profiler()
 
-    def run_pipelined(self, max_batches: Optional[int] = None) -> None:
-        """Unpaced loop with one batch in flight: a decode-ahead worker
-        thread polls + decodes batch N+1 (the C++ JSON decoder releases
-        the GIL, so this genuinely overlaps) while the main thread
-        dispatches batch N to the device and collects batch N-1's
-        results for its sinks — throughput mode, where the wall-clock
-        per batch approaches max(decode, device+transport) instead of
-        their sum (the reference's receiver-thread overlap, P6).
+    def run_pipelined(
+        self,
+        max_batches: Optional[int] = None,
+        depth: Optional[int] = None,
+    ) -> None:
+        """Unpaced loop with up to ``depth`` batches in flight (conf
+        ``datax.job.process.pipeline.depth``, default 2): a decode-ahead
+        worker thread polls + decodes batch N+1 (the C++ JSON decoder
+        releases the GIL, so this genuinely overlaps) while the main
+        thread dispatches batch N to the device and — once the window
+        is full — finishes the OLDEST in-flight batch (collect + sinks
+        + commit + ack). Throughput mode: the wall-clock per batch
+        approaches max(decode, device, transport) instead of their sum,
+        and at depth >= 2 a batch's D2H transfer and sink I/O hide
+        under the device steps of the batches behind it.
 
-        At-least-once holds across the window: each batch joins the
-        source's un-acked FIFO at poll time (the FIFO is lock-guarded)
-        and is acked (in order) only after its own sinks succeed; a
-        failure anywhere requeues every un-acked batch before
-        rethrowing."""
+        Ordering/recovery invariants at every depth:
+        - finish/commit is strictly FIFO (the window is a deque popped
+          from the left), so state-table commits, acks and offset
+          checkpoints happen in dispatch order;
+        - each batch joins its source's un-acked FIFO at poll time and
+          is acked (in order) only after its own sinks succeed; a
+          failure anywhere requeues EVERY un-acked batch in the window
+          before rethrowing (at-least-once);
+        - a UDF ``on_interval`` refresh mid-window is safe: every
+          ``PendingBatch`` snapshots the pipeline/schemas of the step
+          that produced it, so deep windows decode against their own
+          compiled shapes."""
+        from collections import deque
         from concurrent.futures import ThreadPoolExecutor
 
-        pending = None  # (PendingBatch, consumed, batch_time_ms, t0, trace)
+        if depth is None:
+            depth = self.processor.pipeline_depth
+        depth = max(1, depth)
+        # FIFO window of (PendingBatch, consumed, batch_time_ms, t0, trace)
+        pending = deque()
         pool = ThreadPoolExecutor(1)
         fut = None
         fut_trace = None  # the trace of the batch `fut` is decoding
@@ -423,10 +455,9 @@ class StreamingHost:
 
         try:
             while not self._stop:
-                inflight = 1 if pending is not None else 0
                 if (
                     max_batches is not None
-                    and self.batches_processed + inflight >= max_batches
+                    and self.batches_processed + len(pending) >= max_batches
                 ):
                     break
                 iter_t0 = time.time()
@@ -438,35 +469,42 @@ class StreamingHost:
                 trace, fut, fut_trace = fut_trace, None, None
                 handle = self._dispatch_traced(trace, raw, batch_time_ms)
                 # decode-ahead: the NEXT batch's poll starts now,
-                # overlapping the previous batch's collect + sinks —
-                # but only if a next iteration will actually run
-                # (batches started so far incl. this one = processed +
-                # unfinished pending + this)
-                started = self.batches_processed + inflight + 1
+                # overlapping this window's collects + sinks — but only
+                # if a next iteration will actually run (batches started
+                # so far incl. this one = processed + window + this)
+                started = self.batches_processed + len(pending) + 1
                 if not self._stop and (
                     max_batches is None or started < max_batches
                 ):
                     fut_trace = self.tracer.begin("streaming/batch")
                     fut = pool.submit(self._traced_poll, fut_trace)
-                if pending is not None:
-                    self._finish(*pending)
+                pending.append((handle, consumed, batch_time_ms, t0, trace))
+                if len(pending) > depth:
+                    # window full: retire the oldest batch (strict
+                    # FIFO). depth=1 is the legacy single-`pending`
+                    # overlap: finish N-1 right after dispatching N.
+                    self._finish(
+                        *pending.popleft(), inflight_depth=len(pending) + 1
+                    )
                 # backpressure on iteration time, not Latency-Batch: a
-                # pipelined batch's latency spans ~2 iterations by design
+                # pipelined batch's latency spans ~depth iterations by
+                # design
                 self._update_backpressure((time.time() - iter_t0) * 1000.0)
-                pending = (handle, consumed, batch_time_ms, t0, trace)
-            if pending is not None and not self._stop:
-                self._finish(*pending)
+            while pending and not self._stop:
+                self._finish(
+                    *pending.popleft(), inflight_depth=len(pending) + 1
+                )
         except Exception:
             # settle the in-flight poll FIRST, then requeue everything
-            # un-acked (covers poll/dispatch failures; _finish requeues
-            # its own failures before rethrowing, and requeue_unacked
-            # is idempotent)
+            # un-acked across the whole window (covers poll/dispatch
+            # failures; _finish requeues its own failures before
+            # rethrowing, and requeue_unacked is idempotent)
             drain(fut)
             fut = None
             if fut_trace is not None:
                 fut_trace.end(status="aborted")
-            if pending is not None:
-                pending[4].end(status="aborted")  # idempotent
+            for item in pending:
+                item[4].end(status="aborted")  # idempotent
             for s in self.sources.values():
                 s.requeue_unacked()
             raise
@@ -495,6 +533,7 @@ class StreamingHost:
         if self.obs_server is not None:
             self.obs_server.stop()
             self.obs_server = None
+        self.dispatcher.close()
         for s in self.sources.values():
             s.close()
 

@@ -23,6 +23,7 @@ inside the single jitted step.
 
 from __future__ import annotations
 
+import functools
 import json
 import logging
 import os
@@ -53,6 +54,19 @@ from .timewindow import (
 )
 
 logger = logging.getLogger(__name__)
+
+# default in-flight window of the pipelined hosts (conf
+# datax.job.process.pipeline.depth): decode/dispatch of batch N+k
+# proceeds while up to `depth` earlier batches compute and their D2H
+# copies land; finish/commit stays strictly FIFO
+DEFAULT_PIPELINE_DEPTH = 2
+
+# sized output transfer: adapt the per-output D2H copy to the rows a
+# flow actually produces (EWMA of observed counts, bucketed to powers
+# of two) instead of the full padded capacity
+TRANSFER_EWMA_ALPHA = 0.25
+TRANSFER_HEADROOM = 4  # sized cap >= HEADROOM * EWMA (burst absorption)
+MIN_TRANSFER_ROWS = 256  # below this, shrinking saves nothing
 
 _CTYPE_TO_PLAN = {
     ColType.LONG: "long",
@@ -337,6 +351,30 @@ class FlowProcessor:
         # on_interval failures skipped this/previous batches, drained
         # into the DATAX-<flow>:UdfRefreshError metric at collect()
         self.udf_refresh_errors = 0
+
+        # pipelining + sized output transfer conf
+        # (datax.job.process.pipeline.*): `depth` is the in-flight
+        # window of the pipelined hosts; `sizedtransfer` adapts the
+        # per-output D2H copy to observed row counts (off under a mesh,
+        # whose sharded outputs would gather on the slice)
+        pipe_conf = process_conf.get_sub_dictionary("pipeline.")
+        depth = pipe_conf.get_int_option("depth")
+        if depth is None:
+            depth = DEFAULT_PIPELINE_DEPTH
+        elif depth < 1:
+            raise EngineException(
+                f"process.pipeline.depth must be >= 1, got {depth}"
+            )
+        self.pipeline_depth = depth
+        self.sized_transfer = (
+            (pipe_conf.get_or_else("sizedtransfer", "true") or "").lower()
+            != "false"
+        ) and mesh is None
+        # per-output EWMA of observed valid row counts — the sized
+        # transfer capacity tracks this, bucketed to powers of two
+        self.transfer_ewma: Dict[str, float] = {}
+        # counters drained into Transfer_<name>_Count metrics at collect
+        self.transfer_stats: Dict[str, int] = {}
 
         self.interval_s = float(
             input_conf.get_or_else("streaming.intervalinseconds", "1")
@@ -1167,11 +1205,27 @@ class FlowProcessor:
         # dispatch may consume these handles before this batch collects
         self.window_buffers = new_rings
         self.state_data = new_state
+        # sized output transfer: shrink each output's D2H copy to its
+        # adaptive capacity (power-of-two bucket over the count EWMA).
+        # The device has already compacted valid rows to the front, so
+        # the slice keeps every real row as long as the cap holds; the
+        # full-capacity table stays referenced for the two-phase
+        # overflow fallback in collect().
+        fetch_tables: Dict[str, TableData] = dict(out_datasets)
+        fetch_caps: Dict[str, int] = {}
+        for n, t in out_datasets.items():
+            full_cap = int(t.valid.shape[0])
+            cap = self.transfer_capacity(n, full_cap)
+            fetch_caps[n] = cap
+            if cap < full_cap:
+                fetch_tables[n] = _slice_table(t, cap)
         handle = PendingBatch(
             self, self.pipeline, out_datasets, new_state, counts_vec,
             batch_time_ms, new_base_ms, t0,
             out_names=list(self.output_datasets),
             target_names=[s.target for s in self.specs.values()],
+            fetch_tables=fetch_tables,
+            fetch_caps=fetch_caps,
         )
         # begin the device->host result copies NOW (async enqueue, free):
         # by the time collect() runs — typically one pipelined iteration
@@ -1193,6 +1247,38 @@ class FlowProcessor:
         """
         return self.dispatch_batch(raw, batch_time_ms).collect()
 
+    # -- sized output transfer --------------------------------------------
+    def transfer_capacity(self, name: str, full_cap: int) -> int:
+        """Adaptive D2H transfer capacity for output ``name``: the EWMA
+        of observed valid counts with ``TRANSFER_HEADROOM`` x burst
+        margin, bucketed to a power of two. Engages only once counts
+        have been observed and only when it at least halves the copy
+        (otherwise the full fetch is simpler and no slower)."""
+        if not self.sized_transfer:
+            return full_cap
+        ewma = self.transfer_ewma.get(name)
+        if ewma is None:
+            return full_cap
+        cap = _pow2_ceil(
+            max(int(ewma * TRANSFER_HEADROOM) + 1, MIN_TRANSFER_ROWS)
+        )
+        return cap if cap * 2 <= full_cap else full_cap
+
+    def observe_transfer_counts(self, counts: Dict[str, int]) -> None:
+        """Feed observed per-output valid counts into the EWMA (called
+        from ``PendingBatch.collect``; an overflow re-fetch also bumps
+        the EWMA straight to the observed count so the very next batch
+        sizes correctly)."""
+        a = TRANSFER_EWMA_ALPHA
+        for n, c in counts.items():
+            prev = self.transfer_ewma.get(n)
+            self.transfer_ewma[n] = (
+                float(c) if prev is None else a * c + (1.0 - a) * prev
+            )
+
+    def _bump_transfer_stat(self, key: str) -> None:
+        self.transfer_stats[key] = self.transfer_stats.get(key, 0) + 1
+
     def commit(self) -> None:
         """Commit state-table pointers after sinks succeed."""
         for st in self.state_tables.values():
@@ -1213,6 +1299,54 @@ def _host_sort(rows: List[dict], order: List[Tuple[str, bool]]) -> None:
         rows.sort(key=kf, reverse=not asc)
 
 
+def _pow2_ceil(n: int) -> int:
+    """Smallest power of two >= n (n >= 1)."""
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _slice_table(t: TableData, cap: int) -> TableData:
+    """Device-side shrink of an (already compacted) output table to its
+    sized transfer capacity — the D2H copy then moves ``cap`` rows
+    instead of the full padded capacity. One compiled slice per
+    (table layout, cap) pair; caps are power-of-two buckets, so the
+    trace count stays logarithmic. The full-capacity source is
+    deliberately NOT donated into the slice: the two-phase overflow
+    fallback re-fetches it when ``counts_vec`` reveals the sized cap
+    undershot."""
+    return TableData(
+        {c: v[:cap] if v.shape[:1] == t.valid.shape else v
+         for c, v in t.cols.items()},
+        t.valid[:cap],
+    )
+
+
+# does this backend's Array support copy_to_host_async? Probed ONCE per
+# process (the satellite fix for the old blanket try/except in
+# start_fetch, which also swallowed *real* transfer errors): capability
+# misses are cached and counted as a metric; after a successful probe,
+# transfer failures propagate to the batch loop like any other error.
+_ASYNC_COPY_SUPPORT: Optional[bool] = None
+
+
+def _async_copy_supported(arr) -> bool:
+    global _ASYNC_COPY_SUPPORT
+    if _ASYNC_COPY_SUPPORT is None:
+        if not hasattr(arr, "copy_to_host_async"):
+            _ASYNC_COPY_SUPPORT = False
+        else:
+            try:
+                arr.copy_to_host_async()  # idempotent enqueue
+                _ASYNC_COPY_SUPPORT = True
+            except (AttributeError, NotImplementedError, TypeError):
+                _ASYNC_COPY_SUPPORT = False
+    return _ASYNC_COPY_SUPPORT
+
+
+def _host_table_nbytes(t: TableData) -> int:
+    return sum(a.nbytes for a in t.cols.values()) + t.valid.nbytes
+
+
 # batches at or below this capacity fetch counts + whole outputs in one
 # device_get instead of syncing counts first and slicing on device —
 # one host<->device round-trip instead of two (latency mode)
@@ -1228,6 +1362,8 @@ class PendingBatch:
         counts_vec, batch_time_ms: int, base_ms: int, t0: float,
         out_names: Optional[List[str]] = None,
         target_names: Optional[List[str]] = None,
+        fetch_tables: Optional[Dict[str, TableData]] = None,
+        fetch_caps: Optional[Dict[str, int]] = None,
     ):
         self.proc = proc
         # THIS batch's pipeline: a UDF onInterval refresh may rebuild
@@ -1245,30 +1381,47 @@ class PendingBatch:
             else [s.target for s in proc.specs.values()]
         )
         self.out_datasets = out_datasets
+        # sized-transfer views: what start_fetch copies and collect
+        # reads first; out_datasets stays the full-capacity fallback
+        self.fetch_tables = (
+            fetch_tables if fetch_tables is not None else dict(out_datasets)
+        )
+        self.fetch_caps = fetch_caps or {
+            n: int(t.valid.shape[0]) for n, t in self.fetch_tables.items()
+        }
         self.state = state  # THIS batch's state, for the A/B overwrite
         self.counts_vec = counts_vec
         self.batch_time_ms = batch_time_ms
         self.base_ms = base_ms
         self.t0 = t0
         self._prefetched = False
+        # D2H accounting for this batch (Transfer_* metrics)
+        self._d2h_bytes = 0
+        self._transferred_rows = 0
 
     def start_fetch(self) -> None:
         """Enqueue async device->host copies of everything collect()
-        reads (counts + compacted output tables). Transport then
+        reads (counts + the SIZED output tables). Transport then
         overlaps the host's next-batch work instead of being paid as a
-        blocking sync inside collect(). Transfers are latency-bound,
-        not byte-bound, on split hosts — so the whole (compacted)
-        tables are streamed rather than syncing counts first and
-        slicing device-side, which would cost a second round trip."""
-        try:
-            self.counts_vec.copy_to_host_async()
-            for t in self.out_datasets.values():
-                for a in t.cols.values():
-                    if hasattr(a, "copy_to_host_async"):
-                        a.copy_to_host_async()
-                t.valid.copy_to_host_async()
-        except Exception:  # noqa: BLE001 — backend-dependent capability
-            return  # no async host copies here; collect() syncs instead
+        blocking sync inside collect(). Transfers are latency-bound AND
+        byte-bound on split hosts — so the sized (power-of-two bucketed)
+        tables stream ahead of time, and only an overflow (detected from
+        ``counts_vec`` at collect) pays a second round trip for the full
+        table.
+
+        Backend capability (``copy_to_host_async``) is probed once per
+        process; an unsupported backend falls back to the synchronous
+        fetch in collect() and is counted in
+        ``Transfer_AsyncCopyFallback_Count``. Real transfer errors are
+        NOT swallowed — they propagate to the batch loop for retry."""
+        if not _async_copy_supported(self.counts_vec):
+            self.proc._bump_transfer_stat("AsyncCopyFallback")
+            return
+        self.counts_vec.copy_to_host_async()
+        for t in self.fetch_tables.values():
+            for a in t.cols.values():
+                a.copy_to_host_async()
+            t.valid.copy_to_host_async()
         self._prefetched = True
 
     def block_until_evaluated(self) -> None:
@@ -1292,11 +1445,12 @@ class PendingBatch:
         proc = self.proc
         with _trace_span("device-fetch"):
             if self._prefetched or proc.batch_capacity <= SMALL_FETCH_ROWS:
-                # whole-table transfer in ONE round trip (counts + outputs
-                # together) — prefetched at dispatch, or small enough that
-                # the extra bytes cost less than a second host<->device sync
+                # sized-table transfer in ONE round trip (counts + sized
+                # outputs together) — prefetched at dispatch, or small
+                # enough that the extra bytes cost less than a second
+                # host<->device sync
                 counts, host_full = jax.device_get(
-                    (self.counts_vec, self.out_datasets)
+                    (self.counts_vec, self.fetch_tables)
                 )
             else:
                 counts = np.asarray(self.counts_vec)
@@ -1323,21 +1477,61 @@ class PendingBatch:
             t: int(counts[1 + 3 * len(names) + i])
             for i, t in enumerate(tnames)
         }
-        source_tables = (
-            host_full if host_full is not None else self.out_datasets
-        )
-        sliced = {
-            n: TableData(
-                {c: v[: dataset_counts[n]]
-                 if v.shape[:1] == t.valid.shape else v
-                 for c, v in t.cols.items()},
-                t.valid[: dataset_counts[n]],
+        if host_full is not None:
+            self._d2h_bytes = counts.nbytes + sum(
+                _host_table_nbytes(t) for t in host_full.values()
             )
-            for n, t in source_tables.items()
-        }
-        host_tables = (
-            sliced if host_full is not None else jax.device_get(sliced)
-        )
+            self._transferred_rows = sum(
+                int(t.valid.shape[0]) for t in host_full.values()
+            )
+            host_tables: Dict[str, TableData] = {}
+            for n, t in host_full.items():
+                cnt = dataset_counts[n]
+                if cnt > int(t.valid.shape[0]):
+                    # two-phase fallback: the sized prefetch undershot
+                    # (count exceeds the adaptive capacity) — re-fetch
+                    # the full-capacity table sliced to the true count.
+                    # Rare by construction (EWMA + headroom + pow2
+                    # bucket), loud in Transfer_Overflow_Count.
+                    proc._bump_transfer_stat("Overflow")
+                    # jump the EWMA straight to the observed count so
+                    # the very next batch sizes above it
+                    proc.transfer_ewma[n] = float(cnt)
+                    full = self.out_datasets[n]
+                    with _trace_span("device-refetch"):
+                        t = jax.device_get(TableData(
+                            {c: v[:cnt]
+                             if v.shape[:1] == full.valid.shape else v
+                             for c, v in full.cols.items()},
+                            full.valid[:cnt],
+                        ))
+                    self._d2h_bytes += _host_table_nbytes(t)
+                    self._transferred_rows += cnt
+                    host_tables[n] = t
+                else:
+                    host_tables[n] = TableData(
+                        {c: v[:cnt] if v.shape[:1] == t.valid.shape else v
+                         for c, v in t.cols.items()},
+                        t.valid[:cnt],
+                    )
+        else:
+            # counts-first path (large batch, no prefetch): slice on
+            # device to the exact counts, then one batched device_get —
+            # already the wire minimum, sized transfer adds nothing
+            sliced = {
+                n: TableData(
+                    {c: v[: dataset_counts[n]]
+                     if v.shape[:1] == t.valid.shape else v
+                     for c, v in t.cols.items()},
+                    t.valid[: dataset_counts[n]],
+                )
+                for n, t in self.out_datasets.items()
+            }
+            host_tables = jax.device_get(sliced)
+            self._d2h_bytes = counts.nbytes + sum(
+                _host_table_nbytes(t) for t in host_tables.values()
+            )
+            self._transferred_rows = sum(dataset_counts.values())
 
         datasets: Dict[str, List[dict]] = {}
         with _trace_span("materialize"):
@@ -1392,4 +1586,19 @@ class PendingBatch:
         if proc.udf_refresh_errors:
             metrics["UdfRefreshError"] = float(proc.udf_refresh_errors)
             proc.udf_refresh_errors = 0
+        # sized-transfer accounting: bytes actually moved D2H for this
+        # batch and the valid/transferred row ratio (1.0 = wire minimum)
+        if names:
+            valid_rows = sum(dataset_counts.values())
+            metrics["Transfer_D2HBytes"] = float(self._d2h_bytes)
+            metrics["Transfer_Efficiency"] = (
+                valid_rows / self._transferred_rows
+                if self._transferred_rows else 1.0
+            )
+        if proc.transfer_stats:
+            for k, v in proc.transfer_stats.items():
+                metrics[f"Transfer_{k}_Count"] = float(v)
+            proc.transfer_stats.clear()
+        # feed the adaptive capacity for the NEXT batches
+        proc.observe_transfer_counts(dataset_counts)
         return datasets, metrics

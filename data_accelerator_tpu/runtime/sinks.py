@@ -541,20 +541,36 @@ def build_output_operators(
 class OutputDispatcher:
     """Parallel fan-out over output operators (the ``.par`` at
     CommonProcessorFactory.scala:311-314); emits per-sink count metrics
-    (Sink_<kind> — OutputManager.scala:122)."""
+    (Sink_<kind> — OutputManager.scala:122).
 
-    def __init__(self, operators: Dict[str, OutputOperator], metric_logger: MetricLogger):
+    The fan-out runs on ONE persistent executor instead of spawning a
+    thread per operator per batch: under the hosts' depth-N pipelined
+    loops, batch N-1's sink I/O lands on already-warm workers while
+    batch N's device step runs, so per-batch thread startup never sits
+    on the critical path."""
+
+    def __init__(
+        self,
+        operators: Dict[str, OutputOperator],
+        metric_logger: MetricLogger,
+        max_workers: Optional[int] = None,
+    ):
+        from concurrent.futures import ThreadPoolExecutor
+
         self.operators = operators
         self.metric_logger = metric_logger
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers or max(1, min(8, len(operators) or 1)),
+            thread_name_prefix="sink",
+        )
 
     def dispatch(
         self, datasets: Dict[str, List[dict]], batch_time_ms: int
     ) -> Dict[str, int]:
         results: Dict[str, int] = {}
-        threads = []
         lock = threading.Lock()
         errors: List[BaseException] = []
-        # carry the caller's batch trace onto the fan-out threads, so
+        # carry the caller's batch trace onto the fan-out workers, so
         # per-sink spans parent under the host's "sinks" span
         trace_pos = tracing.capture()
 
@@ -562,7 +578,7 @@ class OutputDispatcher:
             try:
                 with tracing.activated(trace_pos):
                     counts = op.write(rows, batch_time_ms)
-            except BaseException as e:  # noqa: BLE001 — re-raised after join
+            except BaseException as e:  # noqa: BLE001 — re-raised after wait
                 with lock:
                     errors.append(e)
                 return
@@ -572,13 +588,12 @@ class OutputDispatcher:
                         results.get(f"{MetricName.MetricSinkPrefix}{kind}", 0) + c
                     )
 
-        for name, op in self.operators.items():
-            rows = datasets.get(name, [])
-            t = threading.Thread(target=run_op, args=(name, op, rows))
-            t.start()
-            threads.append(t)
-        for t in threads:
-            t.join()
+        futures = [
+            self._pool.submit(run_op, name, op, datasets.get(name, []))
+            for name, op in self.operators.items()
+        ]
+        for f in futures:
+            f.result()  # run_op never raises; this is the join barrier
         if errors:
             # propagate so the host's batch try/except retries the batch
             # instead of checkpointing past lost events (at-least-once)
@@ -586,3 +601,7 @@ class OutputDispatcher:
         for metric, count in results.items():
             self.metric_logger.send_metric(metric, count, batch_time_ms)
         return results
+
+    def close(self) -> None:
+        """Shut the fan-out pool down (host stop path); idempotent."""
+        self._pool.shutdown(wait=False, cancel_futures=True)

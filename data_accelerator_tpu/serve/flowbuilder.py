@@ -71,6 +71,7 @@ DEFAULT_JOB_COMMON_TOKENS: Dict[str, str] = {
     "jobDriverLogLevel": "WARN",
     "jobNumChips": "_S_{guiJobNumChips}",
     "jobBatchCapacity": "_S_{guiJobBatchCapacity}",
+    "jobPipelineDepth": "_S_{guiJobPipelineDepth}",
     "processedSchemaPath": "_S_{processedSchemaPath}",
 }
 

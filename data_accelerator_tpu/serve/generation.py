@@ -187,6 +187,11 @@ class RuntimeConfigGeneration:
             "guiJobBatchCapacity": str(
                 jobconf.get("jobBatchCapacity") or "65536"
             ),
+            # in-flight window of the pipelined hosts; empty = engine
+            # default (runtime/processor.py DEFAULT_PIPELINE_DEPTH)
+            "guiJobPipelineDepth": str(
+                jobconf.get("jobPipelineDepth") or ""
+            ),
             "processedSchemaPath": os.path.join(
                 self.runtime.resolve(flow_dir), "processedschema.json"
             ),
@@ -476,6 +481,9 @@ class RuntimeConfigGeneration:
                     jt.get("jobBatchCapacity"))
             if jt.get("jobNumChips"):
                 extra["datax.job.process.numchips"] = str(jt.get("jobNumChips"))
+            if jt.get("jobPipelineDepth"):
+                extra["datax.job.process.pipeline.depth"] = str(
+                    jt.get("jobPipelineDepth"))
             for b_i, b in enumerate(ctx.get("batch_inputs") or []):
                 ns = f"datax.job.input.batch.blob.{b_i}"
                 for k, v in b.items():

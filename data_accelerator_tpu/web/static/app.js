@@ -149,18 +149,21 @@ route("#/flow/", async (view, hash) => {
         `device plan @ ${dev.chips} chips — HBM ${fmtBytes(t.hbmBytes || 0)}` +
         ` (persistent ${fmtBytes(t.persistentBytes || 0)}),` +
         ` ICI ${fmtBytes(t.iciBytesPerBatch || 0)}/batch,` +
+        ` D2H ${fmtBytes(t.d2hBytesPerBatch || 0)}/batch,` +
         ` ~${fmtVal(t.flops || 0)} FLOP/batch`),
       h("table", { class: "grid cost-table" },
         h("thead", {}, h("tr", {},
           h("th", {}, "stage"), h("th", {}, "kind"), h("th", {}, "rows"),
-          h("th", {}, "HBM"), h("th", {}, "FLOPs"), h("th", {}, "ICI/batch"))),
+          h("th", {}, "HBM"), h("th", {}, "FLOPs"), h("th", {}, "ICI/batch"),
+          h("th", {}, "D2H/batch"))),
         h("tbody", {}, dev.stages.map((s) => h("tr", {},
           h("td", { class: "mono" }, s.name),
           h("td", {}, s.kind),
           h("td", { class: "num" }, fmtVal(s.rows)),
           h("td", { class: "num" }, fmtBytes(s.hbmBytes)),
           h("td", { class: "num" }, s.flops ? fmtVal(s.flops) : "–"),
-          h("td", { class: "num" }, s.iciBytes ? fmtBytes(s.iciBytes) : "–"))))));
+          h("td", { class: "num" }, s.iciBytes ? fmtBytes(s.iciBytes) : "–"),
+          h("td", { class: "num" }, s.d2hBytes ? fmtBytes(s.d2hBytes) : "–"))))));
   };
   const renderUdfSummary = (u) => {
     if (!u || !u.functions || !u.functions.length) return null;

@@ -96,6 +96,7 @@ DEVICE_GOLDEN = [
     ("dx203_match_matrix_window", "DX203", SEV_WARNING),
     ("dx204_retrace_hazard", "DX204", SEV_WARNING),
     ("dx205_rebase_proximity", "DX205", SEV_WARNING),
+    ("dx206_oversized_output", "DX206", SEV_WARNING),
     ("dx290_device_lowering", "DX290", SEV_ERROR),
     ("dx291_unloadable_udf", "DX291", SEV_WARNING),
 ]

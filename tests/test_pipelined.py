@@ -117,6 +117,46 @@ def test_streaming_host_run_pipelined(tmp_path):
     assert host.batches_processed == 3
 
 
+def test_streaming_host_depth2_smoke(tmp_path):
+    """Tier-1 smoke: the streaming host at an explicit in-flight depth
+    of 2 (conf process.pipeline.depth) runs a handful of batches with
+    sized transfer on, emitting the pipeline/transfer metric family."""
+    d = SettingDictionary({
+        "datax.job.name": "Depth2Smoke",
+        "datax.job.input.default.inputtype": "local",
+        "datax.job.input.default.blobschemafile": SCHEMA,
+        "datax.job.input.default.eventhub.maxrate": "64",
+        "datax.job.input.default.streaming.intervalinseconds": "1",
+        "datax.job.process.transform": str(tmp_path / "t.transform"),
+        "datax.job.process.batchcapacity": "64",
+        "datax.job.process.pipeline.depth": "2",
+        "datax.job.output.Hot.console.maxrows": "0",
+    })
+    (tmp_path / "t.transform").write_text(
+        "--DataXQuery--\n"
+        "Hot = SELECT k, v FROM DataXProcessedInput WHERE v > 5\n"
+    )
+    host = StreamingHost(d)
+    assert host.processor.pipeline_depth == 2
+    seen = {}
+    orig = host.metric_logger.send_batch_metrics
+
+    def spy(metrics, ts):
+        seen.update(metrics)
+        return orig(metrics, ts)
+
+    host.metric_logger.send_batch_metrics = spy
+    try:
+        host.run_pipelined(max_batches=5)
+    finally:
+        host.stop()
+    assert host.batches_processed == 5
+    assert "Pipeline_Depth" in seen and seen["Pipeline_Depth"] >= 1.0
+    assert "Pipeline_Stall_Ms" in seen
+    assert "Transfer_D2HBytes" in seen
+    assert 0.0 < seen["Transfer_Efficiency"] <= 1.0
+
+
 def test_socket_source_depth2_inflight_ack_and_requeue():
     """A pipelined host holds two un-acked batches: polls must deliver
     NEW data (no duplicates), acks release oldest-first, and

@@ -1,14 +1,19 @@
 """Restart/recovery e2e: offset checkpoint resume, A/B state reload,
-backpressure, and the profiler hook (SURVEY §5.3/§5.4 hardening)."""
+backpressure, the profiler hook (SURVEY §5.3/§5.4 hardening), and the
+depth-N in-flight window's failure semantics (FIFO commit +
+at-least-once requeue at depths 1/2/4, UDF refresh mid-window)."""
 
 import json
 import os
+import socket
+import time as _time
 
 import numpy as np
+import pytest
 
 from data_accelerator_tpu.core.config import SettingDictionary
 from data_accelerator_tpu.runtime.host import StreamingHost
-from data_accelerator_tpu.runtime.sources import FileSource
+from data_accelerator_tpu.runtime.sources import FileSource, SocketSource
 
 SCHEMA = json.dumps({"type": "struct", "fields": [
     {"name": "k", "type": "long", "nullable": False, "metadata": {}},
@@ -128,6 +133,221 @@ def test_backpressure_halves_rate_on_overrun(tmp_path, monkeypatch):
     host.run_batch()  # any real batch overruns a 1 ms interval
     assert host._rate_scale == 0.5
     host.stop()
+
+
+# ---------------------------------------------------------------------------
+# depth-N in-flight window: failure injection at depths 1/2/4
+# ---------------------------------------------------------------------------
+DEPTH_SCHEMA = json.dumps({"type": "struct", "fields": [
+    {"name": "k", "type": "long", "nullable": False, "metadata": {}},
+    {"name": "v", "type": "double", "nullable": False, "metadata": {}},
+]})
+
+
+class _RecordingSink:
+    """Records successful writes in arrival order; raises (BEFORE
+    recording) on any batch containing a poisoned k value while armed."""
+
+    kind = "recording"
+
+    def __init__(self):
+        self.batches = []  # (batch_time_ms, [k...]) per successful write
+        self.poison_k = None
+
+    def write(self, dataset, rows, batch_time_ms):
+        ks = [r["k"] for r in rows]
+        if self.poison_k is not None and self.poison_k in ks:
+            raise RuntimeError(f"poisoned batch (k={self.poison_k})")
+        self.batches.append((batch_time_ms, ks))
+        return len(rows)
+
+
+def _depth_host(tmp_path, depth):
+    """StreamingHost over a SocketSource (the UnackedFifo source) with a
+    recording sink on its one output; 4 events per poll."""
+    from data_accelerator_tpu.runtime.sinks import (
+        OutputDispatcher,
+        OutputOperator,
+    )
+
+    t = tmp_path / "depth.transform"
+    t.write_text(
+        "--DataXQuery--\n"
+        "Out = SELECT k, v FROM DataXProcessedInput\n"
+    )
+    conf = SettingDictionary({
+        "datax.job.name": f"Depth{depth}",
+        "datax.job.input.default.blobschemafile": DEPTH_SCHEMA,
+        "datax.job.input.default.eventhub.maxrate": "4",
+        "datax.job.input.default.streaming.intervalinseconds": "1",
+        "datax.job.process.transform": str(t),
+        "datax.job.process.batchcapacity": "4",
+        "datax.job.process.pipeline.depth": str(depth),
+        "datax.job.output.Out.console.maxrows": "0",
+    })
+    src = SocketSource(port=0)
+    host = StreamingHost(conf, source=src)
+    sink = _RecordingSink()
+    host.dispatcher = OutputDispatcher(
+        {"Out": OutputOperator("Out", [sink])}, host.metric_logger
+    )
+    return host, src, sink
+
+
+def _feed_socket(src, n_events):
+    conn = socket.create_connection(("127.0.0.1", src.port), timeout=5)
+    payload = b"".join(
+        json.dumps({"k": i, "v": float(i)}).encode() + b"\n"
+        for i in range(n_events)
+    )
+    conn.sendall(payload)
+    conn.close()
+    deadline = _time.time() + 5
+    while _time.time() < deadline and len(src._buf) < n_events:
+        _time.sleep(0.01)
+    assert len(src._buf) == n_events
+
+
+def _delivered_ks(blob):
+    return [json.loads(ln)["k"] for ln in blob.splitlines() if ln.strip()]
+
+
+@pytest.mark.parametrize("depth", [1, 2, 4])
+def test_depth_window_sink_failure_fifo_and_requeue(tmp_path, depth):
+    """A sink failure anywhere in the window: batches already finished
+    stay committed in FIFO order, the failed batch and EVERY un-acked
+    batch behind it requeue in order, and a rerun delivers all events
+    exactly once through the sink (no lost, no duplicated offsets)."""
+    host, src, sink = _depth_host(tmp_path, depth)
+    try:
+        _feed_socket(src, 16)  # batches B1(k 0-3) .. B4(k 12-15)
+        sink.poison_k = 9  # B3's finish fails at the sink
+        with pytest.raises(RuntimeError, match="poisoned"):
+            host.run_pipelined(max_batches=4)
+        # FIFO: exactly B1 and B2 committed, in dispatch order
+        assert [ks for _t, ks in sink.batches] == [
+            [0, 1, 2, 3], [4, 5, 6, 7],
+        ]
+        times = [t for t, _ks in sink.batches]
+        assert times == sorted(times)
+        assert host.batches_processed == 2
+
+        # every un-acked batch in the window re-delivers in order
+        b3, n3, _ = src.poll_raw(4)
+        assert _delivered_ks(b3) == [8, 9, 10, 11]
+        b4, n4, _ = src.poll_raw(4)
+        assert _delivered_ks(b4) == [12, 13, 14, 15]
+        src.requeue_unacked()  # hand them back for the rerun
+
+        # rerun with the sink healed: everything lands exactly once
+        sink.poison_k = None
+        host.run_pipelined(max_batches=4)
+        assert host.batches_processed == 4
+        all_ks = [k for _t, ks in sink.batches for k in ks]
+        assert all_ks == list(range(16))  # no loss, no duplication
+    finally:
+        host.stop()
+
+
+@pytest.mark.parametrize("depth", [1, 2, 4])
+def test_depth_window_dispatch_failure_requeues_window(tmp_path, depth):
+    """A dispatch failure mid-window: nothing is acked past the oldest
+    committed batch, every polled-but-unfinished batch requeues in
+    order, and a rerun completes with exactly-once sink delivery."""
+    host, src, sink = _depth_host(tmp_path, depth)
+    try:
+        _feed_socket(src, 16)
+        real_dispatch = host.processor.dispatch_batch
+        calls = {"n": 0}
+
+        def failing_dispatch(*a, **kw):
+            calls["n"] += 1
+            if calls["n"] == 3:  # B3's dispatch blows up (re-trace error)
+                raise RuntimeError("dispatch boom")
+            return real_dispatch(*a, **kw)
+
+        host.processor.dispatch_batch = failing_dispatch
+        with pytest.raises(RuntimeError, match="dispatch boom"):
+            host.run_pipelined(max_batches=4)
+        finished = [ks for _t, ks in sink.batches]
+        # at depth 1 B1 finished before B3's dispatch; at depth >= 2 the
+        # whole window was still in flight — either way commit order is
+        # FIFO with no gaps
+        assert finished == [[0, 1, 2, 3]][: len(finished)]
+        n_done = host.batches_processed
+
+        # un-acked batches (everything not finished) re-deliver in order
+        redelivered = []
+        for _ in range(4 - n_done):
+            blob, n, _ = src.poll_raw(4)
+            assert n == 4
+            redelivered.extend(_delivered_ks(blob))
+        assert redelivered == list(range(n_done * 4, 16))
+        src.requeue_unacked()
+
+        host.processor.dispatch_batch = real_dispatch
+        host.run_pipelined(max_batches=4)
+        assert host.batches_processed == 4
+        all_ks = [k for _t, ks in sink.batches for k in ks]
+        assert all_ks == list(range(16))
+    finally:
+        host.stop()
+
+
+def test_udf_refresh_mid_window_uses_snapshotted_pipeline(tmp_path):
+    """A UDF on_interval refresh (re-trace) while earlier batches are
+    still in flight: each PendingBatch decodes against the
+    pipeline/schemas of the step that produced it — batches dispatched
+    before the refresh keep the old captured state, the one after gets
+    the new state, collected FIFO across the window."""
+    import jax.numpy as jnp
+
+    from data_accelerator_tpu.runtime.processor import FlowProcessor
+    from data_accelerator_tpu.udf import JaxUdf
+
+    state = {"factor": 2.0, "pending": False}
+
+    def refresh(ts):
+        if state["pending"]:
+            state["factor"] = 3.0
+            state["pending"] = False
+            return True
+        return False
+
+    u = JaxUdf(
+        "dynscale",
+        lambda x: x.astype(jnp.float32) * state["factor"],
+        out_type="double",
+        on_interval=refresh,
+    )
+    t = tmp_path / "udf.transform"
+    t.write_text(
+        "--DataXQuery--\n"
+        "T = SELECT k, dynscale(v) AS s FROM DataXProcessedInput\n"
+    )
+    proc = FlowProcessor(
+        SettingDictionary({
+            "datax.job.name": "RefreshWindow",
+            "datax.job.input.default.blobschemafile": DEPTH_SCHEMA,
+            "datax.job.process.transform": str(t),
+            "datax.job.process.batchcapacity": "8",
+            "datax.job.process.pipeline.depth": "4",
+        }),
+        udfs={"dynscale": u},
+        output_datasets=["T"],
+    )
+    rows = [{"k": 1, "v": 5.0}]
+    h1 = proc.dispatch_batch(proc.encode_rows(rows, 0), 1000)
+    h2 = proc.dispatch_batch(proc.encode_rows(rows, 0), 2000)
+    state["pending"] = True  # the NEXT dispatch's refresh re-traces
+    h3 = proc.dispatch_batch(proc.encode_rows(rows, 0), 3000)
+    # collect strictly FIFO, all three still in flight until now
+    d1, _ = h1.collect()
+    d2, _ = h2.collect()
+    d3, _ = h3.collect()
+    assert d1["T"][0]["s"] == 10.0  # old trace (factor 2)
+    assert d2["T"][0]["s"] == 10.0  # dispatched pre-refresh: snapshot
+    assert d3["T"][0]["s"] == 15.0  # post-refresh trace (factor 3)
 
 
 def test_profiler_hook_writes_trace(tmp_path):

@@ -270,6 +270,33 @@ class TestGeneration:
         assert job["flow"] == "GenTestFlow"
         assert job["confPath"] == conf_path
 
+    def test_pipeline_depth_jobconfig_flows_to_conf(self, stores):
+        """Designer jobconfig.jobPipelineDepth lands as the runtime's
+        datax.job.process.pipeline.depth; absent, no key is emitted (the
+        engine default applies)."""
+        design, runtime = stores
+        gui = make_gui("DepthConf")
+        gui["process"]["jobconfig"]["jobPipelineDepth"] = "4"
+        design.save(FlowConfigBuilder().build(gui))
+        res = RuntimeConfigGeneration(design, runtime).generate("DepthConf")
+        assert res.ok, res.errors
+        conf = dict(
+            line.split("=", 1)
+            for line in open(res.conf_paths[0]).read().splitlines()
+            if "=" in line
+        )
+        assert conf["datax.job.process.pipeline.depth"] == "4"
+
+        design.save(FlowConfigBuilder().build(make_gui("NoDepthConf")))
+        res2 = RuntimeConfigGeneration(design, runtime).generate("NoDepthConf")
+        assert res2.ok, res2.errors
+        conf2 = dict(
+            line.split("=", 1)
+            for line in open(res2.conf_paths[0]).read().splitlines()
+            if "=" in line
+        )
+        assert "datax.job.process.pipeline.depth" not in conf2
+
     def test_metrics_config_attached(self, stores):
         design, runtime = stores
         design.save(FlowConfigBuilder().build(make_gui()))
