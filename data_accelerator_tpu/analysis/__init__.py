@@ -4,7 +4,7 @@ Design-time counterpart to the runtime compiler — reuses the production
 codegen + parsers so a bad flow config fails in milliseconds with a
 ``DXnnn``-coded diagnostic instead of minutes into a deployed job.
 
-Three tiers:
+Four tiers:
 
 - the semantic tier (``analyze_flow``): reference resolution, type
   propagation, legality, dead flow, device-compilation risk;
@@ -13,11 +13,17 @@ Three tiers:
   DX2xx capacity/recompilation lints (``deviceplan.py``);
 - the UDF tier (``analyze_flow_udfs``): taint-lattice abstract
   interpretation of the flow's UDF device-function ASTs — the DX3xx
-  tracing-safety/purity/determinism lints (``udfcheck.py``).
+  tracing-safety/purity/determinism lints (``udfcheck.py``);
+- the fleet tier (``analyze_fleet_flows``): whole-fleet analysis of a
+  *set* of flows against a fleet spec — first-fit-decreasing placement
+  consuming the DX2xx cost model plus the DX4xx capacity/interference
+  lints (``fleetcheck.py``); also the runtime placement oracle behind
+  ``serve/jobs.py``'s admission gate.
 
 CLI: ``python -m data_accelerator_tpu.analysis flow.json [--json]
-[--device [--chips N]] [--udfs]`` (non-zero exit on error-severity
-diagnostics, optional tiers included when requested).
+[--device [--chips N]] [--udfs] [--fleet [--fleet-spec=spec.json]]``
+(non-zero exit on error-severity diagnostics, optional tiers included
+when requested).
 """
 
 from .analyzer import (
@@ -38,11 +44,24 @@ from .deviceplan import (
 from .diagnostics import (
     CODES,
     PASS_NAMES,
+    REPORT_SCHEMA_VERSION,
     SEV_ERROR,
     SEV_WARNING,
     AnalysisReport,
     Diagnostic,
     Span,
+)
+from .fleetcheck import (
+    DEFAULT_FLEET_CHIPS,
+    FleetReport,
+    FleetSpec,
+    FlowFootprint,
+    PlacementPlan,
+    analyze_fleet,
+    analyze_fleet_flows,
+    flow_footprint,
+    load_fleet_spec,
+    pack_fleet,
 )
 from .typeprop import TableScope, schema_to_types
 from .udfcheck import (
@@ -56,12 +75,18 @@ __all__ = [
     "AnalysisReport",
     "CODES",
     "DEFAULT_CHIPS",
+    "DEFAULT_FLEET_CHIPS",
     "DEFAULT_MAX_STATE_ROWS",
     "DevicePlanReport",
     "Diagnostic",
+    "FleetReport",
+    "FleetSpec",
     "FlowAnalyzer",
     "FlowContext",
+    "FlowFootprint",
     "PASS_NAMES",
+    "PlacementPlan",
+    "REPORT_SCHEMA_VERSION",
     "SEV_ERROR",
     "SEV_WARNING",
     "Span",
@@ -69,6 +94,8 @@ __all__ = [
     "TableScope",
     "UdfCheckReport",
     "UdfSummary",
+    "analyze_fleet",
+    "analyze_fleet_flows",
     "analyze_flow",
     "analyze_flow_device",
     "analyze_flow_udfs",
@@ -76,5 +103,8 @@ __all__ = [
     "analyze_script",
     "check_udf_object",
     "combined_report_dict",
+    "flow_footprint",
+    "load_fleet_spec",
+    "pack_fleet",
     "schema_to_types",
 ]

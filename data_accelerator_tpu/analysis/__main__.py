@@ -2,6 +2,7 @@
 
     python -m data_accelerator_tpu.analysis flow.json [flow2.json ...]
         [--json] [--device] [--chips=N] [--udfs]
+        [--fleet] [--fleet-spec=spec.json]
 
 Each argument is a flow config file: either a designer gui JSON or a
 full flow document (``{"gui": {...}}``). Prints one line per diagnostic
@@ -22,6 +23,19 @@ every declared UDF/UDAF resolves through the production loader and its
 device functions' ASTs are abstract-interpreted under a taint lattice,
 emitting the DX3xx tracing-safety/purity/determinism lints. Same exit
 contract.
+
+``--fleet`` runs the fleet tier (``analysis/fleetcheck.py``) over ALL
+given flows AS A SET: first-fit-decreasing placement of each flow's
+DX2xx HBM total onto the fleet's chips plus the DX4xx capacity/
+interference lints, printing the placement plan (chip -> flows ->
+packed HBM/headroom). ``--fleet-spec=<file.json>`` overrides the
+default fleet (8 chips x 16 GiB, the MULTICHIP slice); keys: chips,
+hbmPerChipBytes, headroomFraction, d2hBytesPerSecPerChip,
+iciBytesPerSecPerChip, iciTopology. With ``--json`` the report gains a
+``fleet`` section carrying the placement plan. Same exit contract.
+
+Unknown ``--`` flags are rejected with exit 2 (a typo like ``--devcie``
+must not silently skip a tier and report a false clean pass).
 
 Exit codes: 0 clean (warnings allowed) · 1 errors found · 2 usage/IO.
 """
@@ -77,6 +91,38 @@ def _print_device_plan(path: str, device) -> None:
         print(line)
 
 
+def _print_fleet_plan(fleet) -> None:
+    spec = fleet.spec
+    plan = fleet.placement
+    state = "feasible" if plan.feasible else "INFEASIBLE"
+    print(
+        f"fleet: {len(fleet.footprints)} flow(s) on {spec.chips} chip(s) "
+        f"x {_fmt_bytes(spec.hbm_per_chip_bytes)} HBM "
+        f"({spec.ici_topology}): {state}"
+    )
+    for chip in plan.chips:
+        if not chip.flows:
+            continue
+        util = chip.utilization(spec)
+        print(
+            f"fleet:   chip {chip.chip}: {', '.join(chip.flows)} — "
+            f"HBM {_fmt_bytes(chip.hbm_bytes)} ({util:.1%} used, "
+            f"headroom {1 - util:.1%})"
+        )
+    for name in plan.oversized:
+        print(f"fleet:   oversized (no chip fits): {name}")
+    for name in plan.unplaced:
+        print(f"fleet:   unplaced (fleet oversubscribed): {name}")
+    for name in plan.unanalyzed:
+        print(f"fleet:   unanalyzed (no device footprint): {name}")
+
+
+# flags the CLI understands; anything else --prefixed is a usage error
+# (a typo like --devcie must not silently skip a tier)
+KNOWN_FLAGS = {"--json", "--device", "--udfs", "--fleet"}
+KNOWN_VALUE_FLAGS = ("--chips=", "--fleet-spec=")
+
+
 def main(argv: List[str]) -> int:
     # the device tier must never touch an accelerator: force abstract
     # eval on the CPU backend before any jax import
@@ -84,14 +130,26 @@ def main(argv: List[str]) -> int:
     as_json = "--json" in argv
     device_tier = "--device" in argv
     udf_tier = "--udfs" in argv
+    fleet_tier = "--fleet" in argv
     chips: Optional[int] = None
+    fleet_spec_path: Optional[str] = None
     for a in argv:
+        if not a.startswith("--"):
+            continue
+        if a in KNOWN_FLAGS:
+            continue
         if a.startswith("--chips="):
             try:
                 chips = int(a.split("=", 1)[1])
             except ValueError:
                 print(f"invalid --chips value: {a}", file=sys.stderr)
                 return 2
+        elif a.startswith("--fleet-spec="):
+            fleet_spec_path = a.split("=", 1)[1]
+        else:
+            print(f"unknown flag: {a}", file=sys.stderr)
+            print(__doc__.strip(), file=sys.stderr)
+            return 2
     paths = [a for a in argv if not a.startswith("--")]
     if not paths:
         print(__doc__.strip(), file=sys.stderr)
@@ -99,10 +157,25 @@ def main(argv: List[str]) -> int:
 
     from .analyzer import analyze_flow
     from .deviceplan import analyze_flow_device, combined_report_dict
+    from .diagnostics import REPORT_SCHEMA_VERSION
     from .udfcheck import analyze_flow_udfs
+
+    fleet_spec = None
+    if fleet_spec_path is not None:
+        from .fleetcheck import load_fleet_spec
+
+        try:
+            fleet_spec = load_fleet_spec(fleet_spec_path)
+        except (OSError, ValueError, KeyError) as e:
+            print(
+                f"{fleet_spec_path}: cannot read fleet spec: {e}",
+                file=sys.stderr,
+            )
+            return 2
 
     any_errors = False
     json_out = []
+    flows: List[dict] = []
     for path in paths:
         try:
             with open(path, "r", encoding="utf-8") as f:
@@ -110,6 +183,7 @@ def main(argv: List[str]) -> int:
         except (OSError, ValueError) as e:
             print(f"{path}: cannot read flow config: {e}", file=sys.stderr)
             return 2
+        flows.append(flow)
         report = analyze_flow(flow)
         device = analyze_flow_device(flow, chips=chips) if device_tier else None
         udfs = analyze_flow_udfs(flow) if udf_tier else None
@@ -145,9 +219,32 @@ def main(argv: List[str]) -> int:
                         f"{u.kind or 'unloadable'} ({u.path}) "
                         f"analyzed={roles}"
                     )
+
+    fleet = None
+    if fleet_tier:
+        from .fleetcheck import analyze_fleet_flows
+
+        fleet = analyze_fleet_flows(flows, spec=fleet_spec)
+        any_errors |= not fleet.ok
+        if not as_json:
+            for d in fleet.diagnostics:
+                print(f"fleet: {d.render()}")
+            print(
+                f"fleet: {len(fleet.errors)} error(s), "
+                f"{len(fleet.warnings)} warning(s)"
+            )
+            _print_fleet_plan(fleet)
+
     if as_json:
-        print(json.dumps(json_out if len(json_out) > 1 else json_out[0],
-                         indent=2))
+        if fleet is not None:
+            print(json.dumps({
+                "schemaVersion": REPORT_SCHEMA_VERSION,
+                "files": json_out,
+                **fleet.to_dict(),
+            }, indent=2))
+        else:
+            print(json.dumps(json_out if len(json_out) > 1 else json_out[0],
+                             indent=2))
     return 1 if any_errors else 0
 
 

@@ -195,7 +195,10 @@ class DevicePlanReport:
         }
 
     def to_dict(self) -> dict:
+        from .diagnostics import REPORT_SCHEMA_VERSION
+
         return {
+            "schemaVersion": REPORT_SCHEMA_VERSION,
             "ok": self.ok,
             "errorCount": len(self.errors),
             "warningCount": len(self.warnings),
@@ -212,21 +215,27 @@ def _ordered(diags: List[Diagnostic]) -> List[Diagnostic]:
 
 def combined_report_dict(
     base: AnalysisReport, device: Optional[DevicePlanReport] = None,
-    udfs=None,
+    udfs=None, fleet=None,
 ) -> dict:
-    """Merge the semantic tier with the optional device and UDF tiers
-    into one response: a superset of ``AnalysisReport.to_dict()`` plus
-    a ``device`` cost report and/or a ``udfs`` summary — what
-    ``flow/validate`` returns with ``device: true`` / ``udfs: true``
-    and what the CLI's ``--device``/``--udfs`` ``--json`` prints."""
+    """Merge the semantic tier with the optional device, UDF and fleet
+    tiers into one response: a superset of ``AnalysisReport.to_dict()``
+    plus a ``device`` cost report, a ``udfs`` summary and/or a ``fleet``
+    placement plan — what ``flow/validate`` returns with ``device:
+    true`` / ``udfs: true`` / ``fleet: true`` and what the CLI's
+    ``--device``/``--udfs`` ``--json`` prints."""
+    from .diagnostics import REPORT_SCHEMA_VERSION
+
     diags = list(base.diagnostics)
     if device is not None:
         diags += list(device.diagnostics)
     if udfs is not None:
         diags += list(udfs.diagnostics)
+    if fleet is not None:
+        diags += list(fleet.diagnostics)
     diags = _ordered(diags)
     errors = [d for d in diags if d.is_error]
     out = {
+        "schemaVersion": REPORT_SCHEMA_VERSION,
         "ok": not errors,
         "errorCount": len(errors),
         "warningCount": len(diags) - len(errors),
@@ -236,6 +245,8 @@ def combined_report_dict(
         out["device"] = device.plan_dict()
     if udfs is not None:
         out["udfs"] = udfs.udfs_dict()
+    if fleet is not None:
+        out["fleet"] = fleet.fleet_dict()
     return out
 
 

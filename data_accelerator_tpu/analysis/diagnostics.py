@@ -141,6 +141,26 @@ CODES: Dict[str, tuple] = {
               "fix the statement per the planner's message (it is the production compiler's own error)"),
     "DX291": (SEV_WARNING, "device analysis unavailable: no concrete input schema or design-time-unloadable UDF",
               "inline the input schema JSON and declare UDF modules importable on the control plane"),
+    # -- pass 8: fleet capacity/interference (analysis/fleetcheck.py,
+    #    the --fleet tier: whole-fleet placement analysis over a SET of
+    #    flow configs against a fleet spec, consuming the DX2xx cost
+    #    model as its placement oracle) ------------------------------
+    "DX400": (SEV_ERROR, "fleet oversubscribed: no feasible placement packs every flow's modeled HBM onto the fleet's chips",
+              "add chips, shrink flow capacities (batch/window/maxgroups), or stop a co-resident flow"),
+    "DX401": (SEV_ERROR, "single flow's modeled HBM footprint exceeds every chip in the fleet: it can never place",
+              "lower the flow's batch capacity/window retention/group bounds, or provision chips with more HBM"),
+    "DX402": (SEV_WARNING, "placement feasible but a chip lands above the configured headroom fraction: one capacity bump or retrace OOMs it",
+              "rebalance by adding chips or shrinking the co-placed flows, or raise headroomFraction deliberately"),
+    "DX403": (SEV_WARNING, "aggregate D2H/ICI bandwidth demand across the fleet exceeds the modeled budget: sync stages will contend",
+              "stagger batch intervals, shrink output capacities (sized transfer), or raise the spec's bandwidth budgets"),
+    "DX410": (SEV_ERROR, "two flows share a checkpoint/state/output directory: restarts corrupt each other's offsets and window state",
+              "give each flow a distinct checkpoint dir and sink folder (flow names key the defaults — rename one flow)"),
+    "DX411": (SEV_ERROR, "Kafka/EventHub consumer-group collision on overlapping topics: the broker splits records between the flows",
+              "set a distinct kafka.groupid/consumerGroup per flow (the default group is shared) or de-overlap topics"),
+    "DX412": (SEV_WARNING, "metric series collision: two flows emit under the same DATAX-<app> key so store/dashboard series interleave",
+              "rename one flow (the metric app name derives from it) so every series key is unique in the shared store"),
+    "DX413": (SEV_WARNING, "observability-port conflict: co-placed flows bind the same process.observability.port on one host",
+              "give each co-placed flow a distinct jobObservabilityPort, or 0 for an ephemeral port"),
     # -- pass 7: UDF tracing-safety/purity/determinism (analysis/
     #    udfcheck.py, the --udfs tier: taint-lattice abstract
     #    interpretation of UDF device-function ASTs) -------------------
@@ -171,7 +191,16 @@ PASS_NAMES = {
     "DX29": "device plan",
     "DX30": "udf tracing safety",
     "DX31": "udf tracing safety",
+    "DX40": "fleet capacity",
+    "DX41": "fleet interference",
 }
+
+# version of every ``--json`` report shape the analysis tiers emit (the
+# CLI per-file/fleet reports and the ``flow/validate`` response). Bump
+# when top-level keys change so downstream consumers (designer,
+# admission gate, CI tooling) can detect report-format drift; a tier-1
+# test pins the current key sets against this number.
+REPORT_SCHEMA_VERSION = 1
 
 
 def make(code: str, table: str, message: str, span: Optional[Span] = None,
@@ -208,6 +237,7 @@ class AnalysisReport:
 
     def to_dict(self) -> dict:
         return {
+            "schemaVersion": REPORT_SCHEMA_VERSION,
             "ok": self.ok,
             "errorCount": len(self.errors),
             "warningCount": len(self.warnings),

@@ -853,7 +853,10 @@ class UdfCheckReport:
         }
 
     def to_dict(self) -> dict:
+        from .diagnostics import REPORT_SCHEMA_VERSION
+
         return {
+            "schemaVersion": REPORT_SCHEMA_VERSION,
             "ok": self.ok,
             "errorCount": len(self.errors),
             "warningCount": len(self.warnings),

@@ -115,6 +115,18 @@ class MetricName:
         r"Transfer_D2HBytes",
         r"Transfer_Efficiency",
         r"Transfer_(AsyncCopyFallback|Overflow)_Count",
+        # fleet placement (serve/jobs.py FleetAdmissionGate, emitted
+        # under the DATAX-Fleet app on every admission check / re-plan):
+        # fleet-wide chip/flow counts, per-chip packed HBM and
+        # utilization from the DX4xx placement plan, admission
+        # rejections, and re-plan rounds (serve/scheduler.py
+        # PlacementReplanner)
+        r"Fleet_Chips",
+        r"Fleet_Flows(Placed|Unplaced)",
+        r"Fleet_MaxChipUtilization",
+        r"Fleet_Chip[0-9]+_(HbmBytes|Utilization)",
+        r"Fleet_AdmissionRejected_Count",
+        r"Placement_Replans_Count",
     )
 
     @classmethod
@@ -126,6 +138,15 @@ class MetricName:
         return any(
             re.fullmatch(p, metric) for p in cls.RUNTIME_METRIC_PATTERNS
         )
+
+    @staticmethod
+    def metric_app_name(job_name: str) -> str:
+        """The ``DATAX-<job>`` metric app key a flow's series live
+        under in the shared MetricStore (the runtime derives the same
+        via ``SettingDictionary.get_metric_app_name``; the fleet
+        analyzer's DX412 series-collision lint derives it statically
+        from the flow name)."""
+        return ProductConstant.MetricAppNamePrefix + job_name
 
     @staticmethod
     def stage_metric(stage: str) -> str:

@@ -20,6 +20,11 @@ Args (key=value):
   objectstore.advertise=  endpoint URL baked into generated objstore://
                      conf references (must be reachable from workers)
   jobclient=local    job submission: local (child processes) or k8s
+  fleetspec=         fleet-spec JSON for the DX4xx admission gate
+                     (chips, hbmPerChipBytes, ... — see ANALYSIS.md
+                     "Placement model"); default 8 x 16 GiB
+  admission=true     false = skip the fleet admission gate on job
+                     submits (the reference's blind-deploy behavior)
   k8s.apiserver=     k8s API server URL (default in-cluster)
   k8s.namespace=     k8s namespace (default "default")
   k8s.image=         engine image for rendered TPU Jobs
@@ -92,11 +97,20 @@ def main(argv=None):
              **{k[4:]: v for k, v in args.items() if k.startswith("k8s.")}},
         )
 
+    fleet_spec = None
+    if args.get("fleetspec"):
+        from ..analysis import load_fleet_spec
+
+        fleet_spec = load_fleet_spec(args["fleetspec"])
+        log.info("fleet spec: %s", fleet_spec.to_dict())
+
     flow_ops = FlowOperation(
         design_storage,
         runtime_storage,
         job_client=job_client,
         env_tokens=env_tokens,
+        fleet_spec=fleet_spec,
+        fleet_admission=args.get("admission", "true") != "false",
     )
     api = DataXApi(
         flow_ops, require_roles=args.get("roles", "false") == "true"
@@ -155,7 +169,11 @@ def main(argv=None):
     if float(args.get("scheduler", "0") or 0):
         from .scheduler import TimedScheduler
 
-        sched = TimedScheduler(flow_ops, interval_s=float(args["scheduler"]))
+        sched = TimedScheduler(
+            flow_ops,
+            interval_s=float(args["scheduler"]),
+            replanner=flow_ops.placement,
+        )
         sched.start()
         parts.append(sched)
         log.info("batch scheduler every %ss", sched.interval_s)

@@ -72,6 +72,7 @@ DEFAULT_JOB_COMMON_TOKENS: Dict[str, str] = {
     "jobNumChips": "_S_{guiJobNumChips}",
     "jobBatchCapacity": "_S_{guiJobBatchCapacity}",
     "jobPipelineDepth": "_S_{guiJobPipelineDepth}",
+    "jobObservabilityPort": "_S_{guiJobObservabilityPort}",
     "processedSchemaPath": "_S_{processedSchemaPath}",
 }
 

@@ -16,7 +16,14 @@ from typing import List, Optional
 
 from .flowbuilder import FlowConfigBuilder
 from .generation import GenerationResult, RuntimeConfigGeneration
-from .jobs import JobOperation, JobState, LocalJobClient, TpuJobClient
+from .jobs import (
+    FleetAdmissionGate,
+    JobOperation,
+    JobState,
+    LocalJobClient,
+    TpuJobClient,
+)
+from .scheduler import PlacementReplanner
 from .storage import DesignTimeStorage, JobRegistry, LocalRuntimeStorage
 
 logger = logging.getLogger(__name__)
@@ -31,6 +38,8 @@ class FlowOperation:
         runtime_storage: LocalRuntimeStorage,
         job_client: Optional[TpuJobClient] = None,
         env_tokens: Optional[dict] = None,
+        fleet_spec=None,
+        fleet_admission: bool = True,
     ):
         self.design = design_storage
         self.runtime = runtime_storage
@@ -39,9 +48,22 @@ class FlowOperation:
             design_storage, runtime_storage, env_tokens=env_tokens
         )
         self.registry: JobRegistry = self.generation.jobs
+        # fleet placement as an admission gate: every job submit is
+        # checked against the DX4xx analyzer before a process spawns
+        # (``fleet_admission=False`` runs the reference's blind-deploy
+        # behavior; ``fleet_spec`` is an ``analysis.FleetSpec``)
+        self.fleet_gate: Optional[FleetAdmissionGate] = None
+        self.placement: Optional[PlacementReplanner] = None
+        if fleet_admission:
+            self.fleet_gate = FleetAdmissionGate(
+                design_storage, self.registry, spec=fleet_spec
+            )
+            self.placement = PlacementReplanner(self.fleet_gate)
         self.jobs = JobOperation(
             self.registry,
             job_client or LocalJobClient(log_dir=runtime_storage.resolve("logs")),
+            admission_gate=self.fleet_gate,
+            replanner=self.placement,
         )
 
     # -- design-time -----------------------------------------------------
@@ -86,6 +108,27 @@ class FlowOperation:
         from ..analysis import analyze_flow_udfs
 
         return analyze_flow_udfs(flow)
+
+    def validate_flow_fleet(self, flow: dict, spec: Optional[dict] = None):
+        """The fleet tier of ``flow/validate`` (``fleet: true``): the
+        candidate flow is analyzed AS A SET with every currently
+        registered flow against the fleet spec (body ``fleetSpec``
+        overrides the default) — the DX4xx capacity/interference lints
+        plus the placement plan. Same analyzer the CLI's ``--fleet``
+        and the job-submission admission gate run."""
+        from ..analysis import FleetSpec, analyze_fleet_flows
+
+        gui = flow.get("gui") if isinstance(flow.get("gui"), dict) else flow
+        name = (gui or {}).get("name")
+        # a re-save of an existing flow competes with the OTHER flows,
+        # not its own registered copy
+        others = [
+            d for d in self.design.get_all() if d.get("name") != name
+        ]
+        return analyze_fleet_flows(
+            [flow] + others,
+            spec=FleetSpec.from_dict(spec) if spec else None,
+        )
 
     def generate_configs(self, flow_name: str) -> GenerationResult:
         doc = self.design.get_by_name(flow_name)

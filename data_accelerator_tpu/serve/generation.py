@@ -192,6 +192,12 @@ class RuntimeConfigGeneration:
             "guiJobPipelineDepth": str(
                 jobconf.get("jobPipelineDepth") or ""
             ),
+            # host Prometheus/health port (0/empty = ephemeral); the
+            # fleet analyzer's DX413 lint flags co-placed flows that
+            # pin the same port
+            "guiJobObservabilityPort": str(
+                jobconf.get("jobObservabilityPort") or ""
+            ),
             "processedSchemaPath": os.path.join(
                 self.runtime.resolve(flow_dir), "processedschema.json"
             ),
@@ -484,6 +490,9 @@ class RuntimeConfigGeneration:
             if jt.get("jobPipelineDepth"):
                 extra["datax.job.process.pipeline.depth"] = str(
                     jt.get("jobPipelineDepth"))
+            if jt.get("jobObservabilityPort"):
+                extra["datax.job.process.observability.port"] = str(
+                    jt.get("jobObservabilityPort"))
             for b_i, b in enumerate(ctx.get("batch_inputs") or []):
                 ns = f"datax.job.input.batch.blob.{b_i}"
                 for k, v in b.items():

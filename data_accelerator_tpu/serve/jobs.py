@@ -42,6 +42,223 @@ class JobState:
     Error = "error"
 
 
+# ---------------------------------------------------------------------------
+# Fleet admission gate: the DX4xx analyzer as a runtime input
+# ---------------------------------------------------------------------------
+# the DX4xx codes that REJECT a submit: capacity infeasibility and the
+# interference classes that corrupt state/streams (warnings — headroom,
+# bandwidth, series/port conflicts — admit but surface in the record)
+ADMISSION_GATE_CODES = ("DX400", "DX401", "DX410", "DX411")
+
+
+class FleetAdmissionError(RuntimeError):
+    """A job submit the fleet analyzer rejected. Carries the gating
+    diagnostics; NOT retried by ``JobOperation._with_retries`` (the
+    fleet state that rejected it does not change by retrying)."""
+
+    def __init__(self, job_name: str, diagnostics):
+        self.job_name = job_name
+        self.diagnostics = list(diagnostics)
+        super().__init__(
+            f"job '{job_name}' rejected by fleet admission: "
+            + "; ".join(d.render() for d in self.diagnostics)
+        )
+
+
+class FleetAdmissionGate:
+    """Consults ``analysis/fleetcheck.py`` at job submission: the
+    candidate flow is analyzed against every flow with running jobs,
+    and a submit that would trigger DX400/DX401/DX410/DX411 is rejected
+    with the diagnostic BEFORE any process spawns. The accepted
+    placement (flow -> chip) is written onto the job record, and
+    ``Fleet_*`` metrics (constants.MetricName) export the packed fleet
+    state on every check/re-plan.
+
+    The reference's cluster clients deployed blind (SURVEY §1 L3 —
+    oversubscription was discovered by watching jobs die); this gate is
+    the cost model ROADMAP item 2(b) promised, used as a runtime input.
+    """
+
+    def __init__(
+        self,
+        design_storage,
+        registry: JobRegistry,
+        spec=None,
+        metric_logger=None,
+    ):
+        self.design = design_storage
+        self.registry = registry
+        self._spec = spec  # analysis.FleetSpec | None (default)
+        self._metrics = metric_logger
+        self.rejected_count = 0
+        # flow name -> (flow-doc fingerprint, FlowFootprint): device
+        # analysis per flow is the expensive step, so footprints are
+        # cached and invalidated by config content
+        self._footprints: Dict[str, tuple] = {}
+
+    @property
+    def spec(self):
+        if self._spec is None:
+            from ..analysis import FleetSpec
+
+            self._spec = FleetSpec()
+        return self._spec
+
+    @property
+    def metrics(self):
+        if self._metrics is None:
+            from ..obs.metrics import MetricLogger
+
+            self._metrics = MetricLogger("DATAX-Fleet")
+        return self._metrics
+
+    # -- footprints ------------------------------------------------------
+    def _footprint(self, name: str, doc: dict):
+        from ..analysis import flow_footprint
+
+        gui = doc.get("gui") if isinstance(doc.get("gui"), dict) else doc
+        fingerprint = json.dumps(gui, sort_keys=True, default=str)
+        cached = self._footprints.get(name)
+        if cached is not None and cached[0] == fingerprint:
+            return cached[1]
+        fp = flow_footprint(doc, name=name)
+        self._footprints[name] = (fingerprint, fp)
+        return fp
+
+    def _active_flow_names(self, exclude_flow: Optional[str] = None):
+        names = []
+        for rec in self.registry.get_all():
+            if rec.get("state") not in (JobState.Running, JobState.Starting):
+                continue
+            flow = rec.get("flow")
+            if not flow or flow == exclude_flow or flow in names:
+                continue
+            names.append(flow)
+        return names
+
+    # -- planning --------------------------------------------------------
+    def plan(self, candidate_doc: Optional[dict] = None,
+             exclude_flow: Optional[str] = None):
+        """Fleet report over the flows with running jobs, optionally
+        plus a candidate flow (excluded from the active set by name so
+        a restart competes against the OTHERS, not its own old slot)."""
+        from ..analysis import analyze_fleet
+
+        footprints = []
+        if candidate_doc is not None:
+            footprints.append(self._footprint(exclude_flow or "", candidate_doc))
+        for name in self._active_flow_names(exclude_flow=exclude_flow):
+            doc = self.design.get_by_name(name)
+            if doc is not None:
+                footprints.append(self._footprint(name, doc))
+        report = analyze_fleet(footprints, spec=self.spec)
+        self._export_metrics(report)
+        return report
+
+    # -- the gate --------------------------------------------------------
+    def admit(self, job: dict) -> dict:
+        """Check one job's flow against the current fleet. On rejection
+        the registry record carries the reason and a
+        ``FleetAdmissionError`` raises before any process spawns; on
+        admission the record carries the accepted placement."""
+        flow_name = job.get("flow")
+        doc = self.design.get_by_name(flow_name) if flow_name else None
+        if doc is None:
+            return job  # no flow doc to analyze (bare job record)
+        report = self.plan(candidate_doc=doc, exclude_flow=flow_name)
+        gating = [
+            d for d in report.diagnostics
+            if d.code in ADMISSION_GATE_CODES
+            and (not d.table or flow_name in d.table.split("/"))
+        ]
+        if gating:
+            self.rejected_count += 1
+            job["admission"] = {
+                "admitted": False,
+                "codes": [d.code for d in gating],
+                "reason": "; ".join(d.render() for d in gating),
+            }
+            self.registry.upsert(job)
+            self.metrics.send_metric(
+                "Fleet_AdmissionRejected_Count", self.rejected_count
+            )
+            raise FleetAdmissionError(job["name"], gating)
+        chip = report.placement.chip_of(flow_name)
+        fp = next(
+            (f for f in report.footprints if f.name == flow_name), None
+        )
+        assignment = next(
+            (c for c in report.placement.chips if c.chip == chip), None
+        )
+        job["admission"] = {"admitted": True, "codes": []}
+        job["placement"] = {
+            "chip": chip,
+            "hbmBytes": fp.hbm_bytes if fp else None,
+            "chipHbmBytes": assignment.hbm_bytes if assignment else None,
+            "headroom": round(1 - assignment.utilization(self.spec), 6)
+            if assignment else None,
+            "fleetChips": self.spec.chips,
+        }
+        return job
+
+    def replan(self):
+        """Recompute placement over the currently running flows (freed
+        capacity becomes reusable) and refresh every active job
+        record's ``placement``. Called by the scheduler's
+        ``PlacementReplanner`` on job stop/start."""
+        report = self.plan()
+        by_chip = {
+            name: c for c in report.placement.chips for name in c.flows
+        }
+        for rec in self.registry.get_all():
+            flow = rec.get("flow")
+            if flow not in by_chip or rec.get("state") not in (
+                JobState.Running, JobState.Starting,
+            ):
+                continue
+            c = by_chip[flow]
+            rec["placement"] = {
+                "chip": c.chip,
+                "hbmBytes": next(
+                    (f.hbm_bytes for f in report.footprints
+                     if f.name == flow), None
+                ),
+                "chipHbmBytes": c.hbm_bytes,
+                "headroom": round(1 - c.utilization(self.spec), 6),
+                "fleetChips": self.spec.chips,
+            }
+            self.registry.upsert(rec)
+        return report
+
+    # -- metrics ---------------------------------------------------------
+    def _export_metrics(self, report) -> None:
+        try:
+            plan = report.placement
+            placed = sum(len(c.flows) for c in plan.chips)
+            unplaced = (
+                len(plan.unplaced) + len(plan.oversized)
+                + len(plan.unanalyzed)
+            )
+            m = {
+                "Fleet_Chips": self.spec.chips,
+                "Fleet_FlowsPlaced": placed,
+                "Fleet_FlowsUnplaced": unplaced,
+                "Fleet_MaxChipUtilization": max(
+                    (c.utilization(self.spec) for c in plan.chips),
+                    default=0.0,
+                ),
+            }
+            for c in plan.chips:
+                if c.flows:
+                    m[f"Fleet_Chip{c.chip}_HbmBytes"] = c.hbm_bytes
+                    m[f"Fleet_Chip{c.chip}_Utilization"] = (
+                        c.utilization(self.spec)
+                    )
+            self.metrics.send_batch_metrics(m)
+        except Exception:  # noqa: BLE001 — metrics must never gate a job
+            logger.exception("fleet metric export failed")
+
+
 class TpuJobClient:
     """Cluster-client interface (ISparkJobClient analog)."""
 
@@ -393,11 +610,26 @@ class JobOperation:
         client: TpuJobClient,
         retries: int = 3,
         retry_interval_s: float = 0.5,
+        admission_gate: Optional[FleetAdmissionGate] = None,
+        replanner=None,
     ):
         self.registry = registry
         self.client = client
         self.retries = retries
         self.retry_interval_s = retry_interval_s
+        # fleet placement: the admission gate rejects an oversubscribing
+        # submit BEFORE the client spawns anything; the replanner
+        # (serve/scheduler.py) recomputes placement after stop/start so
+        # freed capacity is reusable
+        self.admission_gate = admission_gate
+        self.replanner = replanner
+
+    def _notify_replanner(self) -> None:
+        if self.replanner is not None:
+            try:
+                self.replanner.on_job_event()
+            except Exception:  # noqa: BLE001 — re-plan must not fail ops
+                logger.exception("placement re-plan failed")
 
     # -- state sync ------------------------------------------------------
     def sync_job_state(self, job_name: str) -> dict:
@@ -420,8 +652,13 @@ class JobOperation:
             return job  # idempotent start (reference: StartJob short-circuit)
         if batches:
             job["batches"] = batches
+        if self.admission_gate is not None:
+            # raises FleetAdmissionError (recording the rejection on the
+            # registry record) before the client spawns anything
+            job = self.admission_gate.admit(job)
         job = self.client.submit(job)
         self.registry.upsert(job)
+        self._notify_replanner()
         return job
 
     def start_job_with_retries(self, job_name: str, **kw) -> dict:
@@ -433,6 +670,7 @@ class JobOperation:
             return job
         job = self.client.stop(job)
         self.registry.upsert(job)
+        self._notify_replanner()
         return job
 
     def stop_job_with_retries(self, job_name: str) -> dict:
@@ -467,6 +705,10 @@ class JobOperation:
         for _ in range(self.retries):
             try:
                 return fn()
+            except FleetAdmissionError:
+                # deterministic rejection: the fleet state that refused
+                # the job does not change by retrying
+                raise
             except Exception as e:  # noqa: BLE001 — retried, then re-raised
                 last = e
                 logger.warning("job operation failed, retrying: %s", e)

@@ -34,6 +34,7 @@ from urllib.parse import parse_qs, urlparse
 
 from ..compile.codegen import CodegenEngine
 from .flowservice import FlowOperation
+from .jobs import FleetAdmissionError
 from .livequery import KernelService
 from .schemainference import SchemaInferenceManager
 from .sqlanalyzer import SqlAnalyzer
@@ -136,6 +137,15 @@ class DataXApi:
             return 200, {"result": result}
         except ApiError as e:
             return e.status, {"error": {"message": str(e)}}
+        except FleetAdmissionError as e:
+            # fleet admission gate: the submit conflicts with the
+            # current fleet state (DX400/401/410/411) — a client
+            # problem, not a server fault; the diagnostics are the body
+            return 409, {"error": {
+                "message": str(e),
+                "codes": [d.code for d in e.diagnostics],
+                "diagnostics": [d.to_dict() for d in e.diagnostics],
+            }}
         except KeyError as e:
             return 404, {"error": {"message": str(e)}}
         except Exception as e:  # noqa: BLE001 — API boundary
@@ -172,7 +182,12 @@ class DataXApi:
         ``"chips": N`` sets the ICI model's chip count. ``"udfs":
         true`` adds the UDF tier (the CLI's ``--udfs``): DX3xx
         tracing-safety/purity lints merged into the diagnostics plus a
-        ``udfs`` summary of the functions analyzed."""
+        ``udfs`` summary of the functions analyzed. ``"fleet": true``
+        adds the fleet tier (the CLI's ``--fleet``): the candidate flow
+        is analyzed against every currently registered flow — DX4xx
+        capacity/interference lints merged into the diagnostics plus a
+        ``fleet`` placement plan (chip -> flows -> packed HBM/headroom);
+        optional ``"fleetSpec": {...}`` overrides the default fleet."""
         flow = body.get("flow") or body.get("gui")
         if flow is None and (body.get("flowName") or body.get("name")) \
                 and not body.get("process") and not body.get("input"):
@@ -182,7 +197,8 @@ class DataXApi:
         if flow is None:
             flow = body
         report = self.flow_ops.validate_flow(flow)
-        if not body.get("device") and not body.get("udfs"):
+        if not body.get("device") and not body.get("udfs") \
+                and not body.get("fleet"):
             return report.to_dict()
         from ..analysis import combined_report_dict
 
@@ -196,7 +212,13 @@ class DataXApi:
             self.flow_ops.validate_flow_udfs(flow)
             if body.get("udfs") else None
         )
-        return combined_report_dict(report, device, udfs)
+        fleet = (
+            self.flow_ops.validate_flow_fleet(
+                flow, spec=body.get("fleetSpec")
+            )
+            if body.get("fleet") else None
+        )
+        return combined_report_dict(report, device, udfs, fleet)
 
     def _flow_generate(self, body, query):
         res = self.flow_ops.generate_configs(self._flow_name(body, query))

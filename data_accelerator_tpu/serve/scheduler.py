@@ -24,16 +24,48 @@ from typing import Callable, Dict, List, Optional
 logger = logging.getLogger(__name__)
 
 
+class PlacementReplanner:
+    """Re-plans fleet placement whenever jobs start or stop, so freed
+    capacity is immediately reusable by the next admission check.
+
+    The counterpart of ``serve/jobs.py``'s ``FleetAdmissionGate``:
+    the gate decides *whether* a submit fits; this keeps the persisted
+    flow->chip assignments (``JobRegistry`` records' ``placement``) and
+    the ``Fleet_*``/``Placement_*`` metrics in step with the set of
+    jobs actually running. ``JobOperation`` calls ``on_job_event`` after
+    every successful start/stop; ``TimedScheduler`` additionally calls
+    it each tick so jobs that die on their own (crash, batch-mode
+    completion) also release their modeled capacity.
+    """
+
+    def __init__(self, gate):
+        self.gate = gate
+        self.replans = 0
+
+    def on_job_event(self):
+        report = self.gate.replan()
+        self.replans += 1
+        try:
+            self.gate.metrics.send_metric(
+                "Placement_Replans_Count", self.replans
+            )
+        except Exception:  # noqa: BLE001 — metrics must not fail ops
+            logger.exception("placement metric export failed")
+        return report
+
+
 class TimedScheduler:
     def __init__(
         self,
         flow_ops,
         interval_s: float = 60.0,
         now_fn: Callable[[], float] = time.time,
+        replanner: Optional[PlacementReplanner] = None,
     ):
         self.flow_ops = flow_ops
         self.interval_s = interval_s
         self.now = now_fn
+        self.replanner = replanner
         # flow name -> batch index -> last run epoch (oneTime: ran at all)
         self._last_run: Dict[str, Dict[int, float]] = {}
         self._stop = threading.Event()
@@ -96,6 +128,13 @@ class TimedScheduler:
                 self._last_run[name][i] = now
             self.rounds_triggered += 1
             triggered.append(name)
+        if self.replanner is not None:
+            # jobs that exited on their own since the last tick release
+            # their modeled capacity here
+            try:
+                self.replanner.on_job_event()
+            except Exception:  # noqa: BLE001 — scheduler must survive
+                logger.exception("scheduled placement re-plan failed")
         return triggered
 
     # -- background loop --------------------------------------------------

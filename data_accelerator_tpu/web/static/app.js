@@ -165,6 +165,32 @@ route("#/flow/", async (view, hash) => {
           h("td", { class: "num" }, s.iciBytes ? fmtBytes(s.iciBytes) : "–"),
           h("td", { class: "num" }, s.d2hBytes ? fmtBytes(s.d2hBytes) : "–"))))));
   };
+  const renderPlacement = (f) => {
+    // fleet tier (flow/validate fleet: true): placement plan of this
+    // flow + every registered flow on the fleet spec — chip -> flows ->
+    // packed HBM/headroom (the DX4xx admission-gate surface)
+    if (!f || !f.placement) return null;
+    const p = f.placement;
+    const spec = f.spec || {};
+    const chips = p.chips || [];
+    const probs = [].concat(p.unplaced || [], p.oversized || []);
+    return h("div", { class: "cost placement" },
+      h("div", { class: "muted" },
+        `fleet placement @ ${spec.chips} chip(s) x ` +
+        `${fmtBytes(spec.hbmPerChipBytes || 0)} HBM — ` +
+        (p.feasible ? "feasible" : "INFEASIBLE") +
+        (probs.length ? ` (no fit: ${probs.join(", ")})` : "")),
+      h("table", { class: "grid cost-table placement-table" },
+        h("thead", {}, h("tr", {},
+          h("th", {}, "chip"), h("th", {}, "flows"),
+          h("th", {}, "predicted HBM"), h("th", {}, "headroom"))),
+        h("tbody", {}, chips.map((c) => h("tr", {},
+          h("td", { class: "num" }, String(c.chip)),
+          h("td", { class: "mono" }, (c.flows || []).join(", ")),
+          h("td", { class: "num" }, fmtBytes(c.hbmBytes || 0)),
+          h("td", { class: "num" },
+            ((c.headroom || 0) * 100).toFixed(1) + "%"))))));
+  };
   const renderUdfSummary = (u) => {
     if (!u || !u.functions || !u.functions.length) return null;
     return h("div", { class: "muted" },
@@ -184,12 +210,13 @@ route("#/flow/", async (view, hash) => {
         h("span", {}, d.message),
         d.span && d.span.line ? h("span", { class: "muted" }, ` line ${d.span.line}`) : null)),
       renderUdfSummary(r.udfs),
-      renderCostTable(r.device));
+      renderCostTable(r.device),
+      renderPlacement(r.fleet));
   };
   const validate = async () => {
     await save();
     const r = await api("POST", "/api/flow/flow/validate",
-      { flow: gui, device: true, udfs: true });
+      { flow: gui, device: true, udfs: true, fleet: true });
     renderDiags(r);
     toast(r.ok ? "flow is clean" : `${r.errorCount} error(s) found`, r.ok);
     return r;

@@ -150,10 +150,13 @@ def test_golden_udf_diagnostic(fixture, code, severity):
 
 
 def test_every_registered_code_has_a_golden_fixture():
+    from test_fleetcheck import FLEET_GOLDEN
+
     assert (
         {g[1] for g in GOLDEN}
         | {g[1] for g in DEVICE_GOLDEN}
         | {g[1] for g in UDF_GOLDEN}
+        | {g[2] for g in FLEET_GOLDEN}
     ) == set(CODES)
 
 
@@ -364,6 +367,53 @@ def test_cli_json_mode_matches_validate_endpoint():
 def test_cli_usage_error_without_args():
     proc = _run_cli([])
     assert proc.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# report schema pinning: every --json report carries schemaVersion and
+# the current top-level key sets, so downstream consumers (designer,
+# admission gate, CI tooling) can detect report-format drift
+# ---------------------------------------------------------------------------
+def test_json_reports_pin_schema_version_and_keys(tmp_path):
+    from data_accelerator_tpu.analysis import REPORT_SCHEMA_VERSION
+
+    base_keys = {"schemaVersion", "ok", "errorCount", "warningCount",
+                 "diagnostics"}
+    path = os.path.join(FLOWS_DIR, "clean_config2_window_agg.json")
+
+    # semantic tier
+    out = json.loads(_run_cli(["--json", path]).stdout)
+    assert out["schemaVersion"] == REPORT_SCHEMA_VERSION
+    assert set(out) == base_keys | {"file"}
+
+    # device + udf tiers (combined report)
+    out = json.loads(_run_cli(["--json", "--device", "--udfs", path]).stdout)
+    assert out["schemaVersion"] == REPORT_SCHEMA_VERSION
+    assert set(out) == base_keys | {"file", "device", "udfs"}
+    assert set(out["device"]) == {"flow", "chips", "stages", "totals"}
+
+    # fleet tier
+    out = json.loads(_run_cli(["--json", "--fleet", path]).stdout)
+    assert out["schemaVersion"] == REPORT_SCHEMA_VERSION
+    assert set(out) == base_keys | {"files", "fleet"}
+    assert set(out["fleet"]) == {"spec", "flows", "placement"}
+    assert set(out["fleet"]["placement"]) == {
+        "feasible", "chips", "unplaced", "oversized", "unanalyzed"
+    }
+
+
+def test_validate_endpoint_reports_carry_schema_version(flow_ops):
+    from data_accelerator_tpu.analysis import REPORT_SCHEMA_VERSION
+    from data_accelerator_tpu.serve.restapi import DataXApi
+
+    api = DataXApi(flow_ops)
+    for body in (
+        {"flow": load_flow("clean_config2_window_agg")},
+        {"flow": load_flow("clean_config2_window_agg"), "device": True},
+    ):
+        status, out = api.dispatch("POST", "api/flow/validate", body=body)
+        assert status == 200
+        assert out["result"]["schemaVersion"] == REPORT_SCHEMA_VERSION
 
 
 # ---------------------------------------------------------------------------

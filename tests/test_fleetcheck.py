@@ -1,0 +1,550 @@
+"""Fleet analyzer (DX4xx) + admission-gate tests.
+
+- golden fixtures: one fleet document (fleetSpec + flows) per DX4xx
+  code under tests/data/fleets/, each with a clean twin that must
+  produce zero fleet diagnostics
+- placement exactness (acceptance): per-chip HBM totals equal the SUM
+  of the flows' DX2xx cost-model totals exactly — the fleet tier
+  consumes the byte-exact device model, never re-derives it
+- self-lint (tier-1 CI): every shipped scenario flow AND every clean
+  baseline-mirror fixture must co-place cleanly on the default fleet
+  spec
+- CLI contract: --fleet exit codes, --fleet-spec, --json placement
+  plan, strict unknown-flag rejection
+- REST: flow/validate with "fleet": true analyzes the candidate
+  against registered flows, sharing the CLI implementation
+- admission gate: an oversubscribing submit is rejected with DX400
+  BEFORE any process spawns (registry records the reason); the same
+  flow submits cleanly on a larger fleet; stop/start re-plans so freed
+  capacity is reusable
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from data_accelerator_tpu.analysis import (
+    CODES,
+    REPORT_SCHEMA_VERSION,
+    SEV_ERROR,
+    SEV_WARNING,
+    FleetSpec,
+    analyze_fleet_flows,
+    analyze_flow_device,
+    flow_footprint,
+)
+from data_accelerator_tpu.serve.scenarios import shipped_flow_guis
+
+FLEETS_DIR = os.path.join(os.path.dirname(__file__), "data", "fleets")
+FLOWS_DIR = os.path.join(os.path.dirname(__file__), "data", "flows")
+
+
+def load_fleet(name: str) -> dict:
+    with open(os.path.join(FLEETS_DIR, name + ".json")) as f:
+        return json.load(f)
+
+
+def analyze_fixture(name: str):
+    doc = load_fleet(name)
+    return analyze_fleet_flows(
+        doc["flows"], spec=FleetSpec.from_dict(doc["fleetSpec"])
+    )
+
+
+def clean_flow_paths():
+    return sorted(
+        os.path.join(FLOWS_DIR, f)
+        for f in os.listdir(FLOWS_DIR)
+        if f.startswith("clean_") and f.endswith(".json")
+    )
+
+
+# ---------------------------------------------------------------------------
+# golden fixtures: (bad fixture, clean twin, code, severity)
+# ---------------------------------------------------------------------------
+FLEET_GOLDEN = [
+    ("dx400_oversubscribed", "dx400_clean", "DX400", SEV_ERROR),
+    ("dx401_flow_exceeds_chip", "dx401_clean", "DX401", SEV_ERROR),
+    ("dx402_headroom", "dx402_clean", "DX402", SEV_WARNING),
+    ("dx403_bandwidth", "dx403_clean", "DX403", SEV_WARNING),
+    ("dx410_shared_dir", "dx410_clean", "DX410", SEV_ERROR),
+    ("dx411_kafka_collision", "dx411_clean", "DX411", SEV_ERROR),
+    ("dx412_metric_series", "dx412_clean", "DX412", SEV_WARNING),
+    ("dx413_port_conflict", "dx413_clean", "DX413", SEV_WARNING),
+]
+
+
+@pytest.mark.parametrize("fixture,clean,code,severity", FLEET_GOLDEN,
+                         ids=[g[0] for g in FLEET_GOLDEN])
+def test_golden_fleet_diagnostic(fixture, clean, code, severity):
+    report = analyze_fixture(fixture)
+    hits = [d for d in report.diagnostics if d.code == code]
+    assert hits, (
+        f"expected {code}, got {[d.code for d in report.diagnostics]}"
+    )
+    assert hits[0].severity == severity
+    assert hits[0].severity == CODES[code][0]  # registry is source of truth
+    # the clean twin is diagnostics-free through the same analyzer
+    twin = analyze_fixture(clean)
+    assert twin.diagnostics == [], [d.render() for d in twin.diagnostics]
+    assert twin.ok and twin.placement.feasible
+
+
+def test_error_fixture_reports_are_not_ok():
+    for fixture, _clean, code, severity in FLEET_GOLDEN:
+        report = analyze_fixture(fixture)
+        if severity == SEV_ERROR:
+            assert not report.ok, fixture
+        else:
+            # the flagged code itself never escalates to an error (the
+            # dx412 same-name fixture legitimately carries DX410 too:
+            # identical names also share the derived checkpoint dir)
+            assert all(not d.is_error for d in report.diagnostics
+                       if d.code == code), fixture
+
+
+def test_interference_diagnostics_name_both_flows():
+    report = analyze_fixture("dx411_kafka_collision")
+    d = next(d for d in report.diagnostics if d.code == "DX411")
+    assert d.table == "reada/readb"
+
+
+# ---------------------------------------------------------------------------
+# placement exactness: the fleet tier CONSUMES the DX2xx model
+# ---------------------------------------------------------------------------
+def test_placement_totals_equal_costmodel_totals_exactly():
+    """Acceptance: each chip's packed HBM equals the sum of its flows'
+    ``analyze_flow_device`` totals byte-for-byte — no independent
+    re-derivation anywhere in the fleet tier."""
+    flows = {}
+    for path in clean_flow_paths():
+        with open(path) as f:
+            gui = json.load(f)
+        flows[gui.get("name") or os.path.basename(path)] = gui
+    for gui in shipped_flow_guis():
+        flows[gui["name"]] = gui
+    report = analyze_fleet_flows(list(flows.values()))
+    assert report.placement.feasible
+    placed = sum(len(c.flows) for c in report.placement.chips)
+    assert placed == len(flows)
+    for chip in report.placement.chips:
+        expected = 0
+        for name in chip.flows:
+            jobconf = (
+                (flows[name].get("process") or {}).get("jobconfig") or {}
+            )
+            chips_req = int(
+                jobconf.get("jobNumChips")
+                or jobconf.get("jobNumExecutors") or 1
+            )
+            device = analyze_flow_device(flows[name], chips=chips_req)
+            expected += device.totals()["hbmBytes"]
+        assert chip.hbm_bytes == expected  # exact, not approximate
+
+
+def test_footprint_consumes_device_totals_verbatim():
+    with open(os.path.join(FLOWS_DIR, "clean_config2_window_agg.json")) as f:
+        gui = json.load(f)
+    fp = flow_footprint(gui)
+    totals = analyze_flow_device(gui, chips=1).totals()
+    assert fp.hbm_bytes == totals["hbmBytes"]
+    assert fp.persistent_bytes == totals["persistentBytes"]
+    assert fp.d2h_bytes_per_batch == totals["d2hBytesPerBatch"]
+
+
+# ---------------------------------------------------------------------------
+# self-lint (tier-1 CI): the repo's own flows co-place cleanly
+# ---------------------------------------------------------------------------
+def test_fleet_self_lint_shipped_and_baseline_flows():
+    """Every shipped scenario flow AND every clean baseline-mirror
+    fixture must co-place cleanly on the default fleet spec — zero
+    fleet diagnostics, a feasible placement, every flow placed."""
+    flows = [g for g in shipped_flow_guis()]
+    for path in clean_flow_paths():
+        with open(path) as f:
+            flows.append(json.load(f))
+    assert len(flows) >= 6
+    report = analyze_fleet_flows(flows)
+    assert report.diagnostics == [], (
+        [d.render() for d in report.diagnostics]
+    )
+    assert report.placement.feasible
+    assert not report.placement.unanalyzed
+    assert sum(len(c.flows) for c in report.placement.chips) == len(flows)
+
+
+# ---------------------------------------------------------------------------
+# CLI: --fleet / --fleet-spec / --json / strict flags
+# ---------------------------------------------------------------------------
+def _run_cli(args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.setdefault("PYTHONPATH", os.path.dirname(os.path.dirname(__file__)))
+    return subprocess.run(
+        [sys.executable, "-m", "data_accelerator_tpu.analysis", *args],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(__file__)),
+    )
+
+
+def _flow_files(tmp_path, fixture):
+    doc = load_fleet(fixture)
+    paths = []
+    for i, gui in enumerate(doc["flows"]):
+        p = tmp_path / f"flow{i}.json"
+        p.write_text(json.dumps(gui))
+        paths.append(str(p))
+    spec_path = tmp_path / "fleet.json"
+    spec_path.write_text(json.dumps(doc["fleetSpec"]))
+    return paths, str(spec_path)
+
+
+def test_cli_fleet_zero_exit_on_shipped_and_baseline_flows(tmp_path):
+    """Acceptance: ``--fleet`` over every shipped baseline and scenario
+    flow exits 0 on the default fleet spec."""
+    paths = clean_flow_paths()
+    for i, gui in enumerate(shipped_flow_guis()):
+        p = tmp_path / f"scenario{i}.json"
+        p.write_text(json.dumps(gui))
+        paths.append(str(p))
+    proc = _run_cli(["--fleet", *paths])
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "fleet:" in proc.stdout
+    assert "feasible" in proc.stdout
+
+
+def test_cli_fleet_nonzero_on_oversubscription(tmp_path):
+    paths, spec = _flow_files(tmp_path, "dx400_oversubscribed")
+    proc = _run_cli(["--fleet", f"--fleet-spec={spec}", *paths])
+    assert proc.returncode == 1, proc.stdout
+    assert "DX400" in proc.stdout
+    assert "INFEASIBLE" in proc.stdout
+
+
+def test_cli_fleet_warning_keeps_zero_exit(tmp_path):
+    paths, spec = _flow_files(tmp_path, "dx402_headroom")
+    proc = _run_cli(["--fleet", f"--fleet-spec={spec}", *paths])
+    assert proc.returncode == 0, proc.stdout
+    assert "DX402" in proc.stdout
+
+
+def test_cli_fleet_json_carries_placement_plan(tmp_path):
+    paths, spec = _flow_files(tmp_path, "dx400_clean")
+    proc = _run_cli(["--fleet", "--json", f"--fleet-spec={spec}", *paths])
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["schemaVersion"] == REPORT_SCHEMA_VERSION
+    assert out["ok"] is True
+    assert len(out["files"]) == 2
+    placement = out["fleet"]["placement"]
+    assert placement["feasible"] is True
+    placed = [f for c in placement["chips"] for f in c["flows"]]
+    assert sorted(placed) == ["packa", "packb"]
+    # the JSON totals are the cost-model sums, exactly
+    by_name = {f["name"]: f for f in out["fleet"]["flows"]}
+    for chip in placement["chips"]:
+        assert chip["hbmBytes"] == sum(
+            by_name[f]["hbmBytes"] for f in chip["flows"]
+        )
+
+
+def test_cli_bad_fleet_spec_is_usage_error(tmp_path):
+    bad = tmp_path / "spec.json"
+    bad.write_text("{\"chips\": 0}")
+    proc = _run_cli([
+        "--fleet", f"--fleet-spec={bad}",
+        os.path.join(FLOWS_DIR, "clean_config2_window_agg.json"),
+    ])
+    assert proc.returncode == 2
+    assert "fleet spec" in proc.stderr
+
+
+def test_cli_rejects_unknown_flags():
+    """Satellite: a typo like --devcie must not silently skip a tier
+    and report a false clean pass — unknown flags exit 2 with usage."""
+    proc = _run_cli([
+        "--devcie", os.path.join(FLOWS_DIR, "clean_config2_window_agg.json"),
+    ])
+    assert proc.returncode == 2
+    assert "unknown flag: --devcie" in proc.stderr
+    assert "--device" in proc.stderr  # usage text printed
+    # the same path without the typo still exits 0 (not a regression)
+    proc2 = _run_cli([
+        os.path.join(FLOWS_DIR, "clean_config2_window_agg.json"),
+    ])
+    assert proc2.returncode == 0
+
+
+# ---------------------------------------------------------------------------
+# REST: flow/validate "fleet": true
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def api(tmp_path):
+    from test_serve_jobs import FakeJobClient
+
+    from data_accelerator_tpu.serve.flowservice import FlowOperation
+    from data_accelerator_tpu.serve.restapi import DataXApi
+    from data_accelerator_tpu.serve.storage import (
+        LocalDesignTimeStorage,
+        LocalRuntimeStorage,
+    )
+
+    return DataXApi(FlowOperation(
+        LocalDesignTimeStorage(str(tmp_path / "design")),
+        LocalRuntimeStorage(str(tmp_path / "runtime")),
+        job_client=FakeJobClient(),
+    ))
+
+
+def test_validate_endpoint_fleet_against_registered_flows(api):
+    """``fleet: true`` analyzes the candidate against every currently
+    registered flow: a Kafka consumer collision with a registered flow
+    surfaces as DX411 plus the placement plan."""
+    doc = load_fleet("dx411_kafka_collision")
+    registered, candidate = doc["flows"]
+    api.dispatch("POST", "api/flow/save", body=registered)
+    status, out = api.dispatch(
+        "POST", "api/flow/validate", body={"flow": candidate, "fleet": True}
+    )
+    assert status == 200
+    res = out["result"]
+    assert res["ok"] is False
+    assert "DX411" in [d["code"] for d in res["diagnostics"]]
+    assert res["fleet"]["placement"]["chips"]
+    assert res["schemaVersion"] == REPORT_SCHEMA_VERSION
+
+    # the clean twin against the same registered flow passes
+    clean_candidate = load_fleet("dx411_clean")["flows"][1]
+    status, out = api.dispatch(
+        "POST", "api/flow/validate",
+        body={"flow": clean_candidate, "fleet": True},
+    )
+    assert status == 200
+    # registered flow still rides the shared default group, so give the
+    # clean candidate its own: only the pairwise collision must vanish
+    assert "DX411" not in [
+        d["code"] for d in out["result"]["diagnostics"]
+    ]
+
+
+def test_rest_startjobs_rejection_is_409_with_diagnostics(api):
+    """An admission-gated startjobs surfaces as 409 Conflict carrying
+    the DX4xx diagnostics, not a 500."""
+    api.flow_ops.fleet_gate._spec = FleetSpec.from_dict(ONE_CHIP_TINY)
+    for name in ("resta", "restb"):
+        gui = _tiny_gui(name)
+        api.dispatch("POST", "api/flow/save", body=gui)
+        status, out = api.dispatch(
+            "POST", "api/flow/generateconfigs", body={"flowName": name}
+        )
+        assert status == 200, out
+    status, _ = api.dispatch(
+        "POST", "api/flow/startjobs", body={"flowName": "resta"}
+    )
+    assert status == 200
+    status, out = api.dispatch(
+        "POST", "api/flow/startjobs", body={"flowName": "restb"}
+    )
+    assert status == 409
+    assert out["error"]["codes"] == ["DX400"]
+    assert out["error"]["diagnostics"][0]["code"] == "DX400"
+
+
+def test_validate_endpoint_fleet_spec_override(api):
+    doc = load_fleet("dx401_flow_exceeds_chip")
+    status, out = api.dispatch(
+        "POST", "api/flow/validate",
+        body={"flow": doc["flows"][0], "fleet": True,
+              "fleetSpec": doc["fleetSpec"]},
+    )
+    assert status == 200
+    assert "DX401" in [d["code"] for d in out["result"]["diagnostics"]]
+    assert out["result"]["fleet"]["placement"]["oversized"] == ["giant"]
+
+
+# ---------------------------------------------------------------------------
+# admission gate: the analyzer as a runtime input
+# ---------------------------------------------------------------------------
+def _make_ops(tmp_path, client, spec=None, sub="a"):
+    from data_accelerator_tpu.serve.flowservice import FlowOperation
+    from data_accelerator_tpu.serve.storage import (
+        LocalDesignTimeStorage,
+        LocalRuntimeStorage,
+    )
+
+    return FlowOperation(
+        LocalDesignTimeStorage(str(tmp_path / f"design-{sub}")),
+        LocalRuntimeStorage(str(tmp_path / f"runtime-{sub}")),
+        job_client=client,
+        fleet_spec=spec,
+    )
+
+
+def _tiny_gui(name, **jobconf):
+    gui = json.loads(json.dumps(load_fleet("dx400_clean")["flows"][0]))
+    gui["name"] = gui["displayName"] = name
+    gui["process"]["jobconfig"].update(jobconf)
+    return gui
+
+
+ONE_CHIP_TINY = {"chips": 1, "hbmPerChipBytes": 60000,
+                 "headroomFraction": 0.95}
+
+
+class _SpyPopen:
+    """Stands in for subprocess.Popen inside serve.jobs: records every
+    spawn attempt without creating a process."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, cmd, **kw):
+        self.calls.append(cmd)
+
+        class P:
+            pid = 99999
+
+            def poll(self):
+                return None
+
+            def terminate(self):
+                pass
+
+            def kill(self):
+                pass
+
+            def wait(self, timeout=None):
+                return 0
+
+        return P()
+
+
+def test_admission_rejects_oversubscribing_submit_before_spawn(
+    tmp_path, monkeypatch
+):
+    """Satellite: submitting a flow that oversubscribes a 1-chip fleet
+    via LocalJobClient is rejected with DX400 BEFORE a child process is
+    spawned, and the registry record shows the rejection reason."""
+    from data_accelerator_tpu.serve import jobs as jobs_mod
+    from data_accelerator_tpu.serve.jobs import (
+        FleetAdmissionError,
+        LocalJobClient,
+    )
+
+    spy = _SpyPopen()
+    monkeypatch.setattr(jobs_mod.subprocess, "Popen", spy)
+    spec = FleetSpec.from_dict(ONE_CHIP_TINY)
+    ops = _make_ops(
+        tmp_path, LocalJobClient(log_dir=str(tmp_path / "logs")), spec=spec
+    )
+    for name in ("first", "second"):
+        ops.save_flow(_tiny_gui(name))
+        res = ops.generate_configs(name)
+        assert res.ok, res.errors
+
+    [job1] = ops.start_jobs("first")
+    assert len(spy.calls) == 1  # first flow fills the only chip
+    assert job1["placement"]["chip"] == 0
+
+    with pytest.raises(FleetAdmissionError) as ei:
+        ops.start_jobs("second")
+    assert len(spy.calls) == 1  # NO process spawned for the reject
+    assert any(d.code == "DX400" for d in ei.value.diagnostics)
+    rec = ops.registry.get("DataXTpu-second")
+    assert rec["admission"]["admitted"] is False
+    assert "DX400" in rec["admission"]["codes"]
+    assert "oversubscribed" in rec["admission"]["reason"]
+    assert rec.get("state") in (None, "idle")  # never started
+
+
+def test_same_flow_submits_cleanly_on_larger_fleet(tmp_path, monkeypatch):
+    """Acceptance: the flow rejected on the 1-chip fleet submits
+    cleanly on a larger fleet spec."""
+    from data_accelerator_tpu.serve import jobs as jobs_mod
+    from data_accelerator_tpu.serve.jobs import LocalJobClient
+
+    spy = _SpyPopen()
+    monkeypatch.setattr(jobs_mod.subprocess, "Popen", spy)
+    spec = FleetSpec.from_dict({**ONE_CHIP_TINY, "chips": 2})
+    ops = _make_ops(
+        tmp_path, LocalJobClient(log_dir=str(tmp_path / "logs")), spec=spec
+    )
+    for name in ("first", "second"):
+        ops.save_flow(_tiny_gui(name))
+        ops.generate_configs(name)
+    ops.start_jobs("first")
+    [job2] = ops.start_jobs("second")
+    assert len(spy.calls) == 2
+    assert job2["admission"]["admitted"] is True
+    assert job2["placement"]["chip"] == 1  # packed beside, not on, chip 0
+
+
+def test_stop_replans_so_freed_capacity_is_reusable(tmp_path):
+    """Stopping a job re-plans placement: the chip it held admits the
+    next submit (serve/scheduler.py PlacementReplanner)."""
+    from test_serve_jobs import FakeJobClient
+
+    from data_accelerator_tpu.serve.jobs import FleetAdmissionError
+
+    spec = FleetSpec.from_dict(ONE_CHIP_TINY)
+    ops = _make_ops(tmp_path, FakeJobClient(), spec=spec)
+    for name in ("first", "second"):
+        ops.save_flow(_tiny_gui(name))
+        ops.generate_configs(name)
+    ops.start_jobs("first")
+    with pytest.raises(FleetAdmissionError):
+        ops.start_jobs("second")
+    assert ops.placement.replans == 1  # the successful start re-planned
+
+    ops.stop_jobs("first")
+    assert ops.placement.replans == 2  # stop re-planned too
+    [job2] = ops.start_jobs("second")  # freed capacity is reusable
+    assert job2["admission"]["admitted"] is True
+    assert job2["placement"]["chip"] == 0
+    rec = ops.registry.get("DataXTpu-second")
+    assert rec["placement"]["chip"] == 0
+
+
+def test_admission_rejects_interference_not_just_capacity(tmp_path):
+    """DX411 (Kafka consumer collision) gates admission like DX400."""
+    from test_serve_jobs import FakeJobClient
+
+    from data_accelerator_tpu.serve.jobs import FleetAdmissionError
+
+    ops = _make_ops(tmp_path, FakeJobClient())
+    flows = load_fleet("dx411_kafka_collision")["flows"]
+    for gui in flows:
+        ops.save_flow(gui)
+        res = ops.generate_configs(gui["name"])
+        assert res.ok, res.errors
+    ops.start_jobs("reada")
+    with pytest.raises(FleetAdmissionError) as ei:
+        ops.start_jobs("readb")
+    assert any(d.code == "DX411" for d in ei.value.diagnostics)
+
+
+def test_admission_gate_exports_fleet_metrics(tmp_path):
+    from test_serve_jobs import FakeJobClient
+
+    from data_accelerator_tpu.constants import MetricName
+    from data_accelerator_tpu.obs.metrics import MetricLogger
+    from data_accelerator_tpu.obs.store import MetricStore
+
+    store = MetricStore()
+    ops = _make_ops(tmp_path, FakeJobClient())
+    ops.fleet_gate._metrics = MetricLogger("DATAX-Fleet", store=store)
+    ops.save_flow(_tiny_gui("metered"))
+    ops.generate_configs("metered")
+    ops.start_jobs("metered")
+    keys = [k for k in store.keys() if k.startswith("DATAX-Fleet:")]
+    metrics = {k.split(":", 1)[1] for k in keys}
+    assert "Fleet_FlowsPlaced" in metrics
+    assert "Fleet_Chip0_HbmBytes" in metrics
+    assert "Placement_Replans_Count" in metrics
+    # every name the gate emits is a registered engine metric
+    for m in metrics:
+        assert MetricName.is_runtime_metric(m), m

@@ -127,3 +127,23 @@ def test_registry_patterns_documented_in_observability_md():
         )
     for stage in MetricName.STAGES:
         assert stage in doc, f"stage {stage!r} missing from OBSERVABILITY.md"
+
+
+def test_fleet_placement_metrics_are_registered():
+    """The Fleet_*/Placement_* names the admission gate and re-planner
+    emit (serve/jobs.py FleetAdmissionGate, serve/scheduler.py
+    PlacementReplanner) are registry members; emission-side coverage is
+    tests/test_fleetcheck.py::test_admission_gate_exports_fleet_metrics."""
+    for m in (
+        "Fleet_Chips",
+        "Fleet_FlowsPlaced",
+        "Fleet_FlowsUnplaced",
+        "Fleet_MaxChipUtilization",
+        "Fleet_Chip0_HbmBytes",
+        "Fleet_Chip7_Utilization",
+        "Fleet_AdmissionRejected_Count",
+        "Placement_Replans_Count",
+    ):
+        assert MetricName.is_runtime_metric(m), m
+    assert not MetricName.is_runtime_metric("Fleet_Bogus")
+    assert not MetricName.is_runtime_metric("Placement_Chip")
