@@ -38,6 +38,10 @@ Reported figures:
                          enqueued back-to-back, ONE completion sync)
     stage_sync_ms        the completion handshake with the device
     stage_collect_ms     result materialization (prefetched copies)
+- regression: trajectory gate vs the latest committed BENCH_r*.json —
+  fractional events/s and p99 deltas with a ±10% tolerance band;
+  `regressed: true` flags a drop past the band (read alongside
+  bench_context: weather swings of that size have happened).
 - tunnel_sync_rtt_ms: measured cost of a completion sync against an
   IDLE device — the fixed host<->device round trip this harness's
   split-host TPU tunnel imposes (~66 ms; ~0 co-located). Every
@@ -296,6 +300,60 @@ def measure_device_step(proc, payloads, base_ms, sync_rtt_ms, k=16):
     return max(0.0, (elapsed_ms - sync_rtt_ms) / k)
 
 
+def regression_gate(current: dict, tolerance: float = 0.10):
+    """Trajectory gate: compare this run against the latest committed
+    BENCH_r*.json and emit a ``regression`` block — events/s and p99
+    deltas with a tolerance band — so a perf regression is visible in
+    the bench artifact itself instead of only by eyeballing history.
+    Deltas are fractional (observed/previous - 1); ``regressed`` flips
+    when throughput drops OR p99 rule-eval latency grows past the band.
+    The band defaults to ±10%: r3->r4 showed ~13% swing from
+    environment weather alone, so the gate flags, it does not fail —
+    read it with bench_context (loadavg) beside it."""
+    import glob
+    import re as _re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    rounds = []
+    for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        m = _re.search(r"BENCH_r(\d+)\.json$", path)
+        if m:
+            rounds.append((int(m.group(1)), path))
+    if not rounds:
+        return None
+    _, latest = max(rounds)
+    try:
+        with open(latest, encoding="utf-8") as f:
+            doc = json.load(f)
+        prev = doc.get("parsed") or doc
+    except (OSError, ValueError):
+        return None
+
+    def delta(key):
+        a, b = prev.get(key), current.get(key)
+        if not isinstance(a, (int, float)) or not isinstance(b, (int, float)) \
+                or a == 0:
+            return None
+        return round(b / a - 1.0, 4)
+
+    d_eps = delta("value")
+    d_p99_eval = delta("p99_rule_eval_ms")
+    d_p99_batch = delta("p99_batch_ms")
+    regressed = bool(
+        (d_eps is not None and d_eps < -tolerance)
+        or (d_p99_eval is not None and d_p99_eval > tolerance)
+    )
+    return {
+        "baseline": os.path.basename(latest),
+        "baseline_events_per_sec": prev.get("value"),
+        "events_per_sec_delta": d_eps,
+        "p99_rule_eval_delta": d_p99_eval,
+        "p99_batch_delta": d_p99_batch,
+        "tolerance": tolerance,
+        "regressed": regressed,
+    }
+
+
 def main():
     import jax
 
@@ -391,7 +449,7 @@ def main():
     # rule_eval ~= engine + sync.
     p99_engine = hist.percentile(BENCH_FLOW, "engine-host", 99) + device_step
 
-    print(json.dumps({
+    result = {
         "metric": "iot_alerting_events_per_sec_per_chip_ingest_inclusive",
         "value": round(eps, 1),
         "unit": "events/s",
@@ -423,7 +481,11 @@ def main():
         "batch_capacity": capacity,
         "bench_context": bench_context(dec_rows_s),
         "hbm_model": hbm_model_check(proc),
-    }))
+    }
+    reg = regression_gate(result)
+    if reg is not None:
+        result["regression"] = reg
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -96,6 +96,37 @@ def d2h_transfer_bytes(
     return view_output_bytes(types, plan, rows_transferred)
 
 
+def runtime_conformance_model(
+    totals: Dict[str, object],
+    stages: Optional[list] = None,
+    outputs: Optional[Dict[str, dict]] = None,
+) -> dict:
+    """The cost model as a *runtime artifact*: the compact JSON-ready
+    slice of a device-plan report that config generation embeds into
+    the flow's conf (``datax.job.process.conformance.model``) and the
+    host's ``ConformanceMonitor`` judges observations against. Keeps
+    only what the monitor (and humans debugging drift) need — modeled
+    per-batch D2H bytes, HBM totals, per-output modeled occupancy, and
+    the per-stage d2hBytes/hbmBytes breakdown."""
+    return {
+        "totals": {
+            "d2hBytesPerBatch": totals.get("d2hBytesPerBatch"),
+            "hbmBytes": totals.get("hbmBytes"),
+            "modelBytes": totals.get("modelBytes"),
+        },
+        "outputs": dict(outputs or {}),
+        "stages": [
+            {
+                "name": s.get("name"),
+                "kind": s.get("kind"),
+                "hbmBytes": s.get("hbmBytes"),
+                "d2hBytes": s.get("d2hBytes"),
+            }
+            for s in (stages or [])
+        ],
+    }
+
+
 def _log2(n: int) -> float:
     return math.log2(max(int(n), 2))
 

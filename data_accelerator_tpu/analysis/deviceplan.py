@@ -151,6 +151,10 @@ class DevicePlanReport:
     chips: int
     stages: List[StageCost]
     diagnostics: List[Diagnostic]
+    # OUTPUT dataset -> {"rows": modeled cardinality, "capacity": padded
+    # static capacity} — the occupancy side of the runtime conformance
+    # model (obs/conformance.py DX502)
+    outputs: Dict[str, dict] = field(default_factory=dict)
 
     @property
     def errors(self) -> List[Diagnostic]:
@@ -205,6 +209,20 @@ class DevicePlanReport:
             "diagnostics": [d.to_dict() for d in self.diagnostics],
             "device": self.plan_dict(),
         }
+
+    def runtime_model(self) -> dict:
+        """The machine-readable conformance artifact config generation
+        embeds into the flow's conf
+        (``datax.job.process.conformance.model``) — the slice of this
+        report a running host checks itself against
+        (``obs/conformance.py``)."""
+        from .costmodel import runtime_conformance_model
+
+        return runtime_conformance_model(
+            self.totals(),
+            [s.to_dict() for s in self.stages],
+            self.outputs,
+        )
 
 
 def _ordered(diags: List[Diagnostic]) -> List[Diagnostic]:
@@ -897,6 +915,33 @@ def _lint(
 # ---------------------------------------------------------------------------
 # Entry points
 # ---------------------------------------------------------------------------
+def _output_model(bundle: FlowDevicePlan) -> Dict[str, dict]:
+    """Per-OUTPUT modeled row occupancy: the declared-cardinality bound
+    for grouped views (capped by the static group capacity), the padded
+    capacity otherwise. The DX502 baseline."""
+    out: Dict[str, dict] = {}
+    for view in bundle.pipeline.views:
+        if view.name not in bundle.output_datasets:
+            continue
+        p = view.plan
+        rows = view.capacity
+        if p is not None and p.grouped:
+            rows = p.groups_bound
+            cards = [
+                bundle.declared_cardinality.get(c)
+                for c in (p.group_key_cols or ())
+            ]
+            if cards and all(c is not None for c in cards):
+                product = 1
+                for c in cards:
+                    product *= c
+                rows = min(rows, product)
+        out[view.name] = {
+            "rows": int(rows), "capacity": int(view.capacity),
+        }
+    return out
+
+
 def _analyze(
     bundle: Optional[FlowDevicePlan],
     diags: List[Diagnostic],
@@ -917,7 +962,10 @@ def _analyze(
     except Exception as e:  # noqa: BLE001 — abstract eval blowup is a finding
         diags.append(make("DX290", "", f"device plan evaluation failed: {e}"))
         return DevicePlanReport(bundle.name, bundle.chips, [], _ordered(diags))
-    return DevicePlanReport(bundle.name, bundle.chips, stages, _ordered(diags))
+    return DevicePlanReport(
+        bundle.name, bundle.chips, stages, _ordered(diags),
+        outputs=_output_model(bundle),
+    )
 
 
 def analyze_flow_device(

@@ -658,10 +658,17 @@ class CodegenEngine:
                 "alertTile": True,
             }
         )
+        # standing alert rules (obs/alerts.py): every generated
+        # dashboard ships the default rule set — the runtime host
+        # evaluates the same rules from its conf, and the SPA renders
+        # the firing set as annotations on these widgets
+        from ..obs.alerts import default_rules
+
         return {
             "metrics": {
                 "sources": sources,
                 "widgets": widgets,
+                "alertRules": default_rules(),
                 "initParameters": {
                     "widgetSets": ["direct"],
                     "jobNames": {"type": "getCPSparkJobNames"},

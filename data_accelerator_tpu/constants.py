@@ -115,6 +115,19 @@ class MetricName:
         r"Transfer_D2HBytes",
         r"Transfer_Efficiency",
         r"Transfer_(AsyncCopyFallback|Overflow)_Count",
+        # jit re-traces observed since the last collect (UDF refresh
+        # rebuilds + shape/dictionary-growth cache misses); the
+        # conformance monitor's DX503 input
+        r"Retrace_Count",
+        # model-vs-observed conformance (obs/conformance.py): windowed
+        # observed/predicted ratios against the cost-model report
+        # embedded in the conf, plus the cumulative drift-event count
+        r"Conformance_D2HBytes_Ratio",
+        r"Conformance_Occupancy_[A-Za-z0-9_.]+_Ratio",
+        r"Conformance_Drift_Count",
+        # alert engine (obs/alerts.py): count of currently-firing rules,
+        # exported every evaluation so dashboards can chart alert state
+        r"Alerts_Firing",
         # fleet placement (serve/jobs.py FleetAdmissionGate, emitted
         # under the DATAX-Fleet app on every admission check / re-plan):
         # fleet-wide chip/flow counts, per-chip packed HBM and

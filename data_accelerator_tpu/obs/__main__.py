@@ -4,10 +4,19 @@
 reconstructs one micro-batch's span tree from the JSONL flight recorder
 (the ``tracefile`` writer of obs/telemetry.py). ``<batch_id>`` is the
 batch time in epoch ms (what ``streaming/batch/begin`` logs as
-``batchTime``) or a raw trace id.
+``batchTime``) or a raw trace id. Under cross-process propagation
+(``datax.job.process.telemetry.parenttrace``) the rendered tree spans
+the control-plane request down to the batch spans it caused.
 
-The rotated file (``<file>.1``) is read first when present, so a batch
+Rotated segments (``<file>.N`` / ``<file>.N.gz`` — JsonlWriter
+keep/compress rotation) are read oldest-first when present, so a batch
 that rotated out mid-trace still reconstructs completely.
+
+``python -m data_accelerator_tpu.obs alerts [--url U] [--json]``
+fetches a host's (or the website's) ``GET /alerts`` and renders the
+rule table with firing state; ``alerts --validate rules.json``
+schema-checks a rule file (obs/alerts.py RULE_SCHEMA) and exits
+non-zero on errors.
 """
 
 from __future__ import annotations
@@ -19,12 +28,32 @@ import sys
 from typing import Dict, List, Optional
 
 
+def _rotated_paths(path: str) -> List[str]:
+    """Every on-disk segment of a rotated flight recorder, oldest
+    first: ``<path>.N[.gz] .. <path>.1[.gz]`` then the active file
+    (JsonlWriter keep/compress rotation)."""
+    import glob as _glob
+
+    rotated = []
+    for p in _glob.glob(path + ".*"):
+        suffix = p[len(path) + 1:]
+        if suffix.endswith(".gz"):
+            suffix = suffix[:-3]
+        if suffix.isdigit():
+            rotated.append((int(suffix), p))
+    out = [p for _, p in sorted(rotated, reverse=True)]
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
 def load_spans(path: str) -> List[dict]:
+    import gzip
+
     spans: List[dict] = []
-    for p in (path + ".1", path):
-        if not os.path.exists(p):
-            continue
-        with open(p, "r", encoding="utf-8") as f:
+    for p in _rotated_paths(path):
+        opener = gzip.open if p.endswith(".gz") else open
+        with opener(p, "rt", encoding="utf-8") as f:
             for line in f:
                 line = line.strip()
                 if not line:
@@ -40,17 +69,18 @@ def load_spans(path: str) -> List[dict]:
 
 def find_traces(spans: List[dict], batch_id: str) -> List[str]:
     """Trace ids whose root span matches ``batch_id`` (batchTime or
-    trace id)."""
+    trace id). Batch roots carry ``batchTime``; under cross-process
+    propagation they also carry a ``parent`` pointing into the
+    control-plane trace, so the match keys on the property alone."""
     ids: List[str] = []
     for s in spans:
         if s.get("trace") == batch_id and s["trace"] not in ids:
             ids.append(s["trace"])
     for s in spans:
-        if s.get("parent") is None:
-            bt = (s.get("properties") or {}).get("batchTime")
-            if bt is not None and str(bt) == str(batch_id) \
-                    and s["trace"] not in ids:
-                ids.append(s["trace"])
+        bt = (s.get("properties") or {}).get("batchTime")
+        if bt is not None and str(bt) == str(batch_id) \
+                and s["trace"] not in ids:
+            ids.append(s["trace"])
     return ids
 
 
@@ -103,8 +133,7 @@ def cmd_trace(args) -> int:
             {
                 str((s.get("properties") or {}).get("batchTime"))
                 for s in spans
-                if s.get("parent") is None
-                and (s.get("properties") or {}).get("batchTime") is not None
+                if (s.get("properties") or {}).get("batchTime") is not None
             }
         )
         print(
@@ -123,10 +152,59 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_alerts(args) -> int:
+    from .alerts import validate_rules
+
+    if args.validate:
+        try:
+            with open(args.validate, encoding="utf-8") as f:
+                rules = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"cannot read rules file: {e}", file=sys.stderr)
+            return 2
+        errors = validate_rules(rules)
+        if errors:
+            for e in errors:
+                print(e, file=sys.stderr)
+            return 2
+        print(f"{len(rules)} rule(s) valid")
+        return 0
+    import urllib.request
+
+    url = args.url.rstrip("/") + "/alerts"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            payload = json.loads(r.read() or b"{}")
+    except OSError as e:
+        print(f"cannot reach {url}: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(payload, indent=1, default=str))
+        return 0
+    firing = {a["name"] for a in payload.get("firing") or []}
+    rules = payload.get("rules") or []
+    flow = payload.get("flow") or ""
+    print(f"alerts for {flow or '(unnamed)'} — "
+          f"{len(firing)} firing / {len(rules)} rule(s)")
+    for r in rules:
+        state = r.get("state") or ("firing" if r["name"] in firing else "ok")
+        mark = "!" if state == "firing" else (
+            "~" if state == "pending" else " "
+        )
+        val = r.get("value")
+        val_s = f"{val:.4g}" if isinstance(val, (int, float)) else "-"
+        thr = r.get("threshold", r.get("burnRate"))
+        print(f" {mark} {r['name']:<28} {state:<8} "
+              f"value={val_s} threshold={thr} "
+              f"severity={r.get('severity') or 'warn'}")
+    return 1 if firing else 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m data_accelerator_tpu.obs",
-        description="Observability tools over the JSONL flight recorder.",
+        description="Observability tools over the JSONL flight recorder "
+                    "and the /alerts surface.",
     )
     sub = parser.add_subparsers(dest="cmd", required=True)
     tp = sub.add_parser(
@@ -140,9 +218,25 @@ def main(argv=None) -> int:
              "or ./telemetry.jsonl)",
     )
     tp.add_argument("--json", action="store_true", help="raw span JSON")
+    ap = sub.add_parser(
+        "alerts", help="show a host's alert rules and firing set, or "
+                       "validate a rules file"
+    )
+    ap.add_argument(
+        "--url", default="http://127.0.0.1:8080",
+        help="base URL of a host/website observability endpoint "
+             "(GET <url>/alerts)",
+    )
+    ap.add_argument(
+        "--validate", metavar="RULES_JSON",
+        help="schema-check a rule file instead of querying a host",
+    )
+    ap.add_argument("--json", action="store_true", help="raw JSON payload")
     args = parser.parse_args(argv)
     if args.cmd == "trace":
         return cmd_trace(args)
+    if args.cmd == "alerts":
+        return cmd_alerts(args)
     return 2
 
 

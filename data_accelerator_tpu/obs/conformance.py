@@ -1,0 +1,280 @@
+"""Model-vs-observed conformance: runtime drift detection (DX5xx).
+
+The static analysis tiers predict what a deployed flow will cost — the
+DX2xx device-plan model is byte-exact against the XLA lowering
+(``analysis/costmodel.py``), and the fleet placer admits jobs on those
+numbers. Nothing until now checked the *running* job against them.
+Config generation embeds the flow's machine-readable cost-model report
+into the generated conf (``datax.job.process.conformance.model``, a
+compact JSON produced by ``DevicePlanReport.runtime_model()``); at
+runtime a ``ConformanceMonitor`` on each host compares windowed
+observations — ``Transfer_D2HBytes``, per-output occupancy, retrace
+counts — against those predictions and exports:
+
+- ``Conformance_*`` gauges (observed/predicted ratios, merged into the
+  per-batch metric dict so they ride the normal store/Prometheus/SPA
+  path), and
+- typed **drift events** into the flight recorder and metric store:
+
+  | code | name | meaning |
+  |---|---|---|
+  | DX501 | d2h-bytes-drift | windowed observed D2H bytes exceed the modeled per-batch transfer by more than the tolerance band |
+  | DX502 | occupancy-vs-modeled-cardinality | an output's observed row occupancy exceeds the modeled group/join cardinality — the capacity planning input was wrong |
+  | DX503 | unmodeled-retrace | the jitted step re-traced after warmup; steady state is modeled as trace-free |
+
+Events fire on the *transition* into drift (and re-arm on recovery), so
+a sustained drift is one event, not one per batch; the cumulative
+``Conformance_Drift_Count`` gauge keeps the total visible. This is the
+observability substrate ROADMAP item 5's controller reads: you cannot
+act on drift you cannot see.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+# runtime drift code registry (documented in OBSERVABILITY.md
+# "Conformance monitoring (DX5xx)")
+DRIFT_CODES: Dict[str, str] = {
+    "DX501": "d2h-bytes-drift",
+    "DX502": "occupancy-vs-modeled-cardinality",
+    "DX503": "unmodeled-retrace",
+}
+
+# observed/predicted ratio above which DX501 fires (sized transfer makes
+# observed < predicted the healthy direction; exceeding the model means
+# the model missed traffic)
+DEFAULT_D2H_RATIO_HIGH = 1.5
+# observed rows / modeled cardinality above which DX502 fires
+DEFAULT_OCCUPANCY_FACTOR = 2.0
+# windowed samples required before ratios are judged (and before a
+# retrace counts as unmodeled — the first trace IS the model)
+DEFAULT_WARMUP_BATCHES = 4
+DEFAULT_WINDOW = 16
+
+
+@dataclass
+class DriftEvent:
+    """One typed model-vs-observed drift detection."""
+
+    code: str
+    metric: str
+    observed: float
+    predicted: float
+    ratio: float
+    batch_time_ms: Optional[int] = None
+    message: str = ""
+
+    def to_props(self) -> dict:
+        return {
+            "code": self.code,
+            "name": DRIFT_CODES.get(self.code, self.code),
+            "metric": self.metric,
+            "observed": round(self.observed, 2),
+            "predicted": round(self.predicted, 2),
+            "ratio": round(self.ratio, 4),
+            "batchTime": self.batch_time_ms,
+            "message": self.message,
+        }
+
+
+@dataclass
+class ConformanceModel:
+    """The embedded slice of the DX2xx cost report a running host can
+    check itself against. All fields optional — a missing prediction
+    simply disables its checks (the missing-prediction posture is
+    silence, not failure)."""
+
+    d2h_bytes_per_batch: Optional[float] = None
+    hbm_bytes: Optional[float] = None
+    # output dataset -> {"rows": modeled cardinality, "capacity": padded}
+    outputs: Dict[str, dict] = field(default_factory=dict)
+    # per-stage d2hBytes (informational; the CLI/SPA render it)
+    stages: List[dict] = field(default_factory=list)
+
+    @classmethod
+    def from_json(cls, text: str) -> Optional["ConformanceModel"]:
+        try:
+            obj = json.loads(text)
+        except ValueError:
+            logger.warning("unparseable conformance model; monitor off")
+            return None
+        if not isinstance(obj, dict):
+            return None
+        totals = obj.get("totals") or {}
+        return cls(
+            d2h_bytes_per_batch=totals.get("d2hBytesPerBatch"),
+            hbm_bytes=totals.get("hbmBytes"),
+            outputs={
+                k: v for k, v in (obj.get("outputs") or {}).items()
+                if isinstance(v, dict)
+            },
+            stages=list(obj.get("stages") or []),
+        )
+
+    @classmethod
+    def from_conf(cls, dict_) -> Optional["ConformanceModel"]:
+        raw = dict_.get_sub_dictionary(
+            "datax.job.process.conformance."
+        ).get("model")
+        if not raw:
+            return None
+        return cls.from_json(raw)
+
+
+class ConformanceMonitor:
+    """Windowed model-vs-observed comparison, fed once per batch finish
+    with the batch's metric dict (``FlowProcessor`` collect output plus
+    the host's additions). Returns gauges to merge into the same dict
+    and the drift events that fired this batch."""
+
+    def __init__(
+        self,
+        model: ConformanceModel,
+        flow: str = "",
+        window: int = DEFAULT_WINDOW,
+        warmup: int = DEFAULT_WARMUP_BATCHES,
+        d2h_ratio_high: float = DEFAULT_D2H_RATIO_HIGH,
+        occupancy_factor: float = DEFAULT_OCCUPANCY_FACTOR,
+    ):
+        self.model = model
+        self.flow = flow
+        self.window = max(1, int(window))
+        self.warmup = max(1, int(warmup))
+        self.d2h_ratio_high = float(d2h_ratio_high)
+        self.occupancy_factor = float(occupancy_factor)
+        self.batches = 0
+        self.drift_count = 0
+        self._d2h: deque = deque(maxlen=self.window)
+        self._occupancy: Dict[str, deque] = {}
+        # codes (keyed per metric) currently in drift — events fire on
+        # the transition in, re-arm on recovery
+        self._active: set = set()
+
+    @classmethod
+    def from_conf(cls, dict_, flow: str = "") -> Optional["ConformanceMonitor"]:
+        model = ConformanceModel.from_conf(dict_)
+        if model is None:
+            return None
+        sub = dict_.get_sub_dictionary("datax.job.process.conformance.")
+        window = sub.get_int_option("window")
+        warmup = sub.get_int_option("warmup")
+        high = sub.get_double_option("d2hratiohigh")
+        occ = sub.get_double_option("occupancyfactor")
+        return cls(
+            model,
+            flow=flow,
+            window=window if window is not None else DEFAULT_WINDOW,
+            warmup=warmup if warmup is not None else DEFAULT_WARMUP_BATCHES,
+            d2h_ratio_high=(
+                high if high is not None else DEFAULT_D2H_RATIO_HIGH
+            ),
+            occupancy_factor=(
+                occ if occ is not None else DEFAULT_OCCUPANCY_FACTOR
+            ),
+        )
+
+    # -- transitions -----------------------------------------------------
+    def _transition(
+        self, key: str, in_drift: bool, make_event,
+    ) -> Optional[DriftEvent]:
+        if in_drift and key not in self._active:
+            self._active.add(key)
+            self.drift_count += 1
+            return make_event()
+        if not in_drift:
+            self._active.discard(key)
+        return None
+
+    # -- the per-batch pass ----------------------------------------------
+    def observe(
+        self, metrics: Dict[str, float],
+        batch_time_ms: Optional[int] = None,
+    ) -> tuple:
+        """Feed one finished batch's metrics. Returns
+        ``(gauges, events)``: gauges are ``Conformance_*`` entries for
+        the batch's metric dict; events are the drift transitions that
+        fired (typed, flight-recorder-bound)."""
+        self.batches += 1
+        gauges: Dict[str, float] = {}
+        events: List[DriftEvent] = []
+        warmed = self.batches > self.warmup
+
+        # DX501: observed D2H bytes vs the modeled per-batch transfer
+        d2h = metrics.get("Transfer_D2HBytes")
+        predicted_d2h = self.model.d2h_bytes_per_batch
+        if d2h is not None and predicted_d2h:
+            self._d2h.append(float(d2h))
+            mean = sum(self._d2h) / len(self._d2h)
+            ratio = mean / float(predicted_d2h)
+            gauges["Conformance_D2HBytes_Ratio"] = ratio
+            ev = self._transition(
+                "DX501", warmed and ratio > self.d2h_ratio_high,
+                lambda: DriftEvent(
+                    "DX501", "Transfer_D2HBytes", mean,
+                    float(predicted_d2h), ratio, batch_time_ms,
+                    f"windowed D2H bytes {mean:.0f} exceed modeled "
+                    f"{float(predicted_d2h):.0f}/batch by "
+                    f"{ratio:.2f}x (> {self.d2h_ratio_high}x)",
+                ),
+            )
+            if ev:
+                events.append(ev)
+
+        # DX502: per-output occupancy vs modeled cardinality
+        for name, pred in self.model.outputs.items():
+            rows_pred = pred.get("rows")
+            if not rows_pred:
+                continue
+            observed = metrics.get(f"Output_{name}_Events_Count")
+            if observed is None:
+                continue
+            win = self._occupancy.setdefault(
+                name, deque(maxlen=self.window)
+            )
+            win.append(float(observed))
+            mean = sum(win) / len(win)
+            ratio = mean / float(rows_pred)
+            gauges[f"Conformance_Occupancy_{name}_Ratio"] = ratio
+            ev = self._transition(
+                f"DX502:{name}",
+                warmed and ratio > self.occupancy_factor,
+                lambda n=name, m=mean, rp=float(rows_pred), r=ratio: DriftEvent(
+                    "DX502", f"Output_{n}_Events_Count", m, rp, r,
+                    batch_time_ms,
+                    f"output '{n}' occupancy {m:.0f} rows/batch vs "
+                    f"modeled cardinality {rp:.0f} "
+                    f"({r:.2f}x > {self.occupancy_factor}x) — re-check "
+                    "declared key cardinality (DX200/DX202 inputs)",
+                ),
+            )
+            if ev:
+                events.append(ev)
+
+        # DX503: re-traces after warmup (steady state is trace-free)
+        retraces = metrics.get("Retrace_Count")
+        if retraces:
+            ev = self._transition(
+                "DX503", warmed,
+                lambda: DriftEvent(
+                    "DX503", "Retrace_Count", float(retraces), 0.0,
+                    float(retraces), batch_time_ms,
+                    f"{retraces:.0f} jit re-trace(s) after warmup — "
+                    "the cost model assumes a trace-free steady state "
+                    "(see DX204/DX3xx for static retrace hazards)",
+                ),
+            )
+            if ev:
+                events.append(ev)
+        else:
+            self._active.discard("DX503")
+
+        if self.drift_count:
+            gauges["Conformance_Drift_Count"] = float(self.drift_count)
+        return gauges, events

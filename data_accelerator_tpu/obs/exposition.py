@@ -41,15 +41,29 @@ class HealthState:
     last-batch success, checkpoint age.
     """
 
+    # EWMA weight for the pipeline-stall gauge (recent batches dominate
+    # but one outlier stall can't flip readiness on its own)
+    STALL_EWMA_ALPHA = 0.3
+
     def __init__(
         self,
         flow: str = "",
         checkpoint_interval_s: Optional[float] = None,
         batch_interval_s: float = 1.0,
+        stall_fail_ms: Optional[float] = None,
     ):
         self.flow = flow
         self.checkpoint_interval_s = checkpoint_interval_s
         self.batch_interval_s = batch_interval_s
+        # sustained-stall readiness threshold: the smoothed
+        # Pipeline_Stall_Ms above this means the pipeline is saturated
+        # or wedged, not merely overlapping (default: 10 batch
+        # intervals, floored at 10 s so split-host tunnel RTTs and
+        # normal overlap never trip it)
+        self.stall_fail_ms = (
+            stall_fail_ms if stall_fail_ms is not None
+            else max(10_000.0, 10.0 * batch_interval_s * 1000.0)
+        )
         self.started_at = time.time()
         self.batches_processed = 0
         self.batches_failed = 0
@@ -60,6 +74,8 @@ class HealthState:
         self.last_error: Optional[str] = None
         self.last_checkpoint_at: Optional[float] = None
         self.source_watermark_ms: Optional[int] = None
+        self.pipeline_stall_ms: Optional[float] = None  # EWMA
+        self.firing_alerts: List[dict] = []
         self._lock = threading.Lock()
 
     # -- host-side updates -------------------------------------------------
@@ -90,6 +106,24 @@ class HealthState:
         with self._lock:
             self.source_watermark_ms = watermark_ms
 
+    def record_stall(self, stall_ms: float) -> None:
+        """Feed one batch's ``Pipeline_Stall_Ms`` into the smoothed
+        stall gauge the readiness probe judges."""
+        a = self.STALL_EWMA_ALPHA
+        with self._lock:
+            prev = self.pipeline_stall_ms
+            self.pipeline_stall_ms = (
+                float(stall_ms) if prev is None
+                else a * float(stall_ms) + (1.0 - a) * prev
+            )
+
+    def record_alerts(self, firing: List[dict]) -> None:
+        """Latest firing-alert set from the host's AlertEngine — probes
+        report it so k8s (and humans curling /readyz) see degradation,
+        not just liveness."""
+        with self._lock:
+            self.firing_alerts = list(firing)
+
     # -- probes ------------------------------------------------------------
     def health(self) -> Dict[str, object]:
         with self._lock:
@@ -111,6 +145,13 @@ class HealthState:
                 "lastError": self.last_error,
                 "checkpointAgeSeconds": self.checkpoint_age_s(now),
                 "sourceLagMs": self.source_lag_ms(now),
+                "pipelineStallMs": (
+                    None if self.pipeline_stall_ms is None
+                    else round(self.pipeline_stall_ms, 1)
+                ),
+                "firingAlerts": [
+                    a.get("name") for a in self.firing_alerts
+                ],
             }
 
     def checkpoint_age_s(self, now: Optional[float] = None) -> Optional[float]:
@@ -149,6 +190,15 @@ class HealthState:
                         f"checkpoint stale: {age:.1f}s "
                         f"(interval {self.checkpoint_interval_s:.0f}s)"
                     )
+            if (
+                self.pipeline_stall_ms is not None
+                and self.pipeline_stall_ms > self.stall_fail_ms
+            ):
+                reasons.append(
+                    f"sustained pipeline stall: "
+                    f"{self.pipeline_stall_ms:.0f}ms smoothed "
+                    f"(> {self.stall_fail_ms:.0f}ms)"
+                )
         return reasons
 
 
@@ -170,8 +220,14 @@ def render_prometheus(
     histograms: Optional[HistogramRegistry] = None,
     store: Optional[MetricStore] = None,
     health: Optional[HealthState] = None,
+    alerts=None,
 ) -> str:
-    """All process observability as Prometheus text exposition v0.0.4."""
+    """All process observability as Prometheus text exposition v0.0.4.
+
+    ``alerts``: an ``obs.alerts.AlertEngine`` — per-rule
+    ``datax_alert_firing`` gauges plus the ``datax_alerts_firing``
+    total, evaluated at scrape time so ``GET /alerts`` and this
+    exposition can never disagree on the firing set."""
     histograms = histograms if histograms is not None else HISTOGRAMS
     out: List[str] = []
 
@@ -251,6 +307,33 @@ def render_prometheus(
             out.append(
                 f'datax_source_lag_ms{{{labels}}} {_fmt(h["sourceLagMs"])}'
             )
+        if h["pipelineStallMs"] is not None:
+            out.append("# TYPE datax_pipeline_stall_ms gauge")
+            out.append(
+                f'datax_pipeline_stall_ms{{{labels}}} '
+                f'{_fmt(h["pipelineStallMs"])}'
+            )
+
+    if alerts is not None:
+        snap = alerts.snapshot()
+        firing_names = {a["name"] for a in snap["firing"]}
+        out.append(
+            "# HELP datax_alert_firing 1 when the named alert rule is "
+            "firing."
+        )
+        out.append("# TYPE datax_alert_firing gauge")
+        for rule in snap["rules"]:
+            out.append(
+                f'datax_alert_firing{{flow="{_esc(snap["flow"])}",'
+                f'rule="{_esc(rule["name"])}",'
+                f'severity="{_esc(rule.get("severity") or "warn")}"}} '
+                f'{1 if rule["name"] in firing_names else 0}'
+            )
+        out.append("# TYPE datax_alerts_firing gauge")
+        out.append(
+            f'datax_alerts_firing{{flow="{_esc(snap["flow"])}"}} '
+            f'{len(firing_names)}'
+        )
     return "\n".join(out) + "\n"
 
 
@@ -267,10 +350,12 @@ class ObservabilityServer:
         store: Optional[MetricStore] = None,
         host: str = "127.0.0.1",
         port: int = 0,
+        alerts=None,
     ):
         self.health = health
         self.histograms = histograms if histograms is not None else HISTOGRAMS
         self.store = store if store is not None else METRIC_STORE
+        self.alerts = alerts  # obs.alerts.AlertEngine | None
         obs = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -290,11 +375,22 @@ class ObservabilityServer:
                 path = self.path.split("?", 1)[0]
                 if path == "/metrics":
                     body = render_prometheus(
-                        obs.histograms, obs.store, obs.health
+                        obs.histograms, obs.store, obs.health,
+                        alerts=obs.alerts,
                     ).encode()
                     self._send(
                         200, body,
                         "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                elif path == "/alerts":
+                    if obs.alerts is None:
+                        payload = {"flow": obs.health.flow, "rules": [],
+                                   "firing": []}
+                    else:
+                        payload = obs.alerts.snapshot()
+                    self._send(
+                        200, json.dumps(payload, default=str).encode(),
+                        "application/json",
                     )
                 elif path == "/healthz":
                     self._send(

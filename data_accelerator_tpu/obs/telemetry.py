@@ -43,17 +43,32 @@ class LogWriter(TelemetryWriter):
 class JsonlWriter(TelemetryWriter):
     """Append-only JSONL trace file — the local flight recorder.
 
-    Size-capped: when the file would exceed ``max_bytes`` it rotates to
-    ``<path>.1`` (replacing any previous rotation), so a long-running
-    job keeps at most ~2x the cap on disk while the trace CLI can still
-    see up to a full cap of history in the rotated file.
+    Size-capped: when the file would exceed ``max_bytes`` it rotates
+    through ``<path>.1 .. <path>.N`` (``keep`` segments, oldest
+    dropped), so a long-running job keeps at most ~(keep+1)x the cap on
+    disk while the trace CLI can still reconstruct up to ``keep`` caps
+    of history from the rotated segments. With ``compress`` the rotated
+    segments are gzipped (``<path>.N.gz``) — the active file always
+    stays plain text so `tail -f`/grep keep working. Rotation is a
+    whole-file rename: a record (and therefore a span line) is never
+    split across segments, so an in-progress batch's spans survive any
+    rotation — some may land in ``.1`` while later ones land in the
+    fresh active file, and the trace reader stitches them back.
     """
 
     DEFAULT_MAX_BYTES = 64 * 1024 * 1024
 
-    def __init__(self, path: str, max_bytes: int = DEFAULT_MAX_BYTES):
+    def __init__(
+        self,
+        path: str,
+        max_bytes: int = DEFAULT_MAX_BYTES,
+        keep: int = 1,
+        compress: bool = False,
+    ):
         self.path = path
         self.max_bytes = max_bytes
+        self.keep = max(1, int(keep))
+        self.compress = bool(compress)
         self._lock = threading.Lock()
         parent = os.path.dirname(os.path.abspath(path))
         if parent:
@@ -65,7 +80,31 @@ class JsonlWriter(TelemetryWriter):
 
     @property
     def rotated_path(self) -> str:
-        return self.path + ".1"
+        return self.path + (".1.gz" if self.compress else ".1")
+
+    def _segment(self, i: int) -> str:
+        return f"{self.path}.{i}" + (".gz" if self.compress else "")
+
+    def _rotate(self) -> None:
+        try:
+            # shift .N-1 -> .N (dropping the oldest), then the active
+            # file becomes .1 — gzipped first when compress is on
+            for i in range(self.keep, 1, -1):
+                if os.path.exists(self._segment(i - 1)):
+                    os.replace(self._segment(i - 1), self._segment(i))
+            if self.compress:
+                import gzip
+                import shutil
+
+                with open(self.path, "rb") as src, gzip.open(
+                    self._segment(1), "wb"
+                ) as dst:
+                    shutil.copyfileobj(src, dst)
+                os.remove(self.path)
+            else:
+                os.replace(self.path, self._segment(1))
+        except OSError:
+            pass  # rotation failure must not lose the record
 
     def write(self, record: Dict[str, Any]) -> None:
         line = json.dumps(record, default=str) + "\n"
@@ -73,10 +112,7 @@ class JsonlWriter(TelemetryWriter):
         with self._lock:
             if self.max_bytes and self._size + len(data) > self.max_bytes \
                     and self._size > 0:
-                try:
-                    os.replace(self.path, self.rotated_path)
-                except OSError:
-                    pass  # rotation failure must not lose the record
+                self._rotate()
                 self._size = 0
             with open(self.path, "a", encoding="utf-8") as f:
                 f.write(line)
@@ -226,17 +262,25 @@ def from_conf(dict_) -> TelemetryLogger:
     """Build from ``datax.job.process.telemetry.*`` conf: ``tracefile``
     (JSONL path) and ``httppost`` (collector endpoint) writers plus the
     process log, mirroring the reference's appinsights conf gate
-    (AppHost init path)."""
+    (AppHost init path). ``tracefile.keep`` (rotated-segment count,
+    default 1) and ``tracefile.compress`` (gzip rotated segments,
+    default false) tune the flight recorder's rotation."""
     sub = dict_.get_sub_dictionary("datax.job.process.telemetry.")
     writers: List[TelemetryWriter] = [LogWriter()]
     trace = sub.get("tracefile")
     if trace:
         max_bytes = sub.get_long_option("tracefilemaxbytes")
+        keep = sub.get_int_option("tracefile.keep")
         writers.append(JsonlWriter(
             trace,
             max_bytes=(
                 max_bytes if max_bytes is not None
                 else JsonlWriter.DEFAULT_MAX_BYTES
+            ),
+            keep=keep if keep is not None else 1,
+            compress=(
+                (sub.get_or_else("tracefile.compress", "false") or "")
+                .lower() == "true"
             ),
         ))
     endpoint = sub.get("httppost")

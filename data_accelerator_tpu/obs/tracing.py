@@ -53,6 +53,34 @@ def _new_trace_id() -> str:
     return f"{int(time.time() * 1000):x}-{rnd:08x}"
 
 
+def _span_prefix() -> str:
+    """Random per-context span-id prefix, used when a context JOINS an
+    existing trace (cross-process propagation): span ids are minted by
+    a per-context counter, so two processes sharing one trace id need
+    disjoint id spaces or their span ids collide."""
+    return f"{struct.unpack('<I', os.urandom(4))[0]:08x}."
+
+
+def format_parent(cap) -> Optional[str]:
+    """Serialize a ``capture()`` as the ``<trace_id>:<span_id>`` string
+    the ``datax.job.process.telemetry.parenttrace`` conf key carries
+    across the process boundary (control plane -> spawned host)."""
+    if cap is None:
+        return None
+    ctx, parent_id = cap
+    return f"{ctx.trace_id}:{parent_id}"
+
+
+def parse_parent(text: Optional[str]):
+    """Inverse of ``format_parent``: ``(trace_id, span_id)`` or None."""
+    if not text or ":" not in text:
+        return None
+    trace_id, _, span_id = text.rpartition(":")
+    if not trace_id or not span_id:
+        return None
+    return trace_id, span_id
+
+
 def current_trace() -> Optional["TraceContext"]:
     """The trace active on THIS thread (None outside any batch)."""
     stack = getattr(_local, "stack", None)
@@ -94,13 +122,35 @@ def span(name: str, **props) -> Iterator[None]:
 
 
 class TraceContext:
-    """One batch's trace: a root span plus explicitly-parented children."""
+    """One batch's trace: a root span plus explicitly-parented children.
 
-    def __init__(self, tracer: "Tracer", name: str, props: Dict):
+    With ``trace_id``/``parent_span_id`` the context JOINS an existing
+    (possibly remote) trace instead of minting one: the root span keeps
+    a parent pointer into the foreign trace and every span id carries a
+    random per-context prefix so concurrent contexts — other batches of
+    the same job, other processes — cannot collide inside the shared
+    trace."""
+
+    def __init__(
+        self,
+        tracer: "Tracer",
+        name: str,
+        props: Dict,
+        trace_id: Optional[str] = None,
+        parent_span_id: Optional[str] = None,
+    ):
         self.tracer = tracer
-        self.trace_id = _new_trace_id()
-        self.root_span_id = "1"
-        self._span_counter = itertools.count(2)
+        self.parent_span_id = parent_span_id
+        if trace_id is not None:
+            self.trace_id = trace_id
+            prefix = _span_prefix()
+        else:
+            self.trace_id = _new_trace_id()
+            prefix = ""
+        self.root_span_id = prefix + "1"
+        self._span_counter = (
+            prefix + str(n) for n in itertools.count(2)
+        )
         self._name = name
         self._props = dict(props)
         self._start_ts = time.time()
@@ -127,7 +177,7 @@ class TraceContext:
             self._ended = True
         self._props.update(props)
         self.tracer._emit_span(
-            self, self._name, self.root_span_id, None,
+            self, self._name, self.root_span_id, self.parent_span_id,
             self._start_ts, (time.perf_counter() - self._start_pc) * 1000.0,
             self._props,
         )
@@ -203,7 +253,13 @@ class TraceContext:
 
 class Tracer:
     """Factory for per-batch traces, bound to a flow's telemetry fan-out
-    and (optionally) the per-stage histogram registry."""
+    and (optionally) the per-stage histogram registry.
+
+    ``parent``: a ``<trace_id>:<span_id>`` string (the
+    ``datax.job.process.telemetry.parenttrace`` conf value) — every
+    trace this tracer begins then JOINS that trace instead of minting
+    its own, so a spawned host's batch spans root in the control-plane
+    request that launched the job."""
 
     def __init__(
         self,
@@ -211,13 +267,20 @@ class Tracer:
         histograms: Optional[HistogramRegistry] = None,
         flow: str = "",
         enabled: bool = True,
+        parent: Optional[str] = None,
     ):
         self.telemetry = telemetry
         self.histograms = histograms
         self.flow = flow
         self.enabled = enabled
+        self.parent = parse_parent(parent)
 
     def begin(self, name: str = "streaming/batch", **props) -> TraceContext:
+        if self.parent is not None:
+            return TraceContext(
+                self, name, props,
+                trace_id=self.parent[0], parent_span_id=self.parent[1],
+            )
         return TraceContext(self, name, props)
 
     def _emit_span(

@@ -132,6 +132,9 @@ class BatchHost:
             enabled=(
                 tele_conf.get_or_else("tracing", "true") or ""
             ).lower() != "false",
+            # batch jobs launched by the control plane join the
+            # launching request's trace, same as streaming hosts
+            parent=tele_conf.get("parenttrace"),
         )
         if table_sink_map is None:
             from ..core.config import SettingNamespace

@@ -55,6 +55,11 @@ class StreamingHost:
         # is conf-gated (process.telemetry.tracing, default on — the
         # overhead is a handful of clock reads per batch); histograms
         # always observe, they are the /metrics + percentile source.
+        # cross-process propagation: when the control plane spawned this
+        # host it passed `telemetry.parenttrace=<trace>:<span>` — every
+        # batch trace then JOINS the control-plane request's trace, so
+        # the flight recorder's span tree for any batch roots in the
+        # REST submit that launched the job (obs/tracing.py)
         tele_conf0 = dict_.get_sub_dictionary("datax.job.process.telemetry.")
         self.tracer = Tracer(
             self.telemetry,
@@ -63,6 +68,16 @@ class StreamingHost:
             enabled=(
                 tele_conf0.get_or_else("tracing", "true") or ""
             ).lower() != "false",
+            parent=tele_conf0.get("parenttrace"),
+        )
+        # model-vs-observed conformance: config generation embeds the
+        # DX2xx cost-model report (process.conformance.model); the
+        # monitor compares windowed observations against it and emits
+        # Conformance_* gauges + DX5xx drift events (obs/conformance.py)
+        from ..obs.conformance import ConformanceMonitor
+
+        self.conformance = ConformanceMonitor.from_conf(
+            dict_, flow=dict_.get_job_name()
         )
 
         input_conf = dict_.get_sub_dictionary(SettingNamespace.JobInputPrefix)
@@ -125,12 +140,29 @@ class StreamingHost:
         # (/metrics, /healthz, /readyz — obs/exposition.py), served when
         # process.observability.port is set (0 = ephemeral port, useful
         # for tests and one-box)
+        stall_fail = dict_.get_sub_dictionary(
+            SettingNamespace.JobProcessPrefix + "observability."
+        ).get_double_option("stallfailms")
         self.health = HealthState(
             flow=dict_.get_job_name(),
             checkpoint_interval_s=(
                 self.checkpoint_interval_s if self.checkpointer else None
             ),
             batch_interval_s=self.interval_s,
+            stall_fail_ms=stall_fail,
+        )
+        # declarative alert rules from the generated conf
+        # (process.alerts.rules, obs/alerts.py): evaluated every batch
+        # finish and on every /alerts-/metrics request over the same
+        # store/histogram/health surfaces the dashboards read
+        from ..obs.alerts import AlertEngine
+
+        self.alerts = AlertEngine.from_conf(
+            dict_,
+            flow=dict_.get_job_name(),
+            store=self.metric_logger.store,
+            histograms=HISTOGRAMS,
+            health=self.health,
         )
         self.obs_server: Optional[ObservabilityServer] = None
         obs_port = dict_.get_sub_dictionary(
@@ -142,6 +174,7 @@ class StreamingHost:
                 histograms=HISTOGRAMS,
                 store=self.metric_logger.store,
                 port=obs_port,
+                alerts=self.alerts,
             )
             self.obs_server.start()
 
@@ -268,6 +301,24 @@ class StreamingHost:
         metrics["IngestRateScale"] = self._rate_scale
         metrics["Pipeline_Depth"] = float(inflight_depth)
         metrics["Pipeline_Stall_Ms"] = stall_ms
+        self.health.record_stall(stall_ms)
+        # model-vs-observed conformance: ratio gauges join this batch's
+        # metrics; drift transitions become typed flight-recorder events
+        # and store rows (obs/conformance.py)
+        if self.conformance is not None:
+            gauges, drift_events = self.conformance.observe(
+                metrics, batch_time_ms
+            )
+            metrics.update(gauges)
+            for ev in drift_events:
+                props = ev.to_props()
+                self.telemetry.track_event("conformance/drift", props)
+                self.metric_logger.send_metric_events(
+                    "Conformance_Drift", [props], batch_time_ms
+                )
+                logger.warning(
+                    "conformance drift %s: %s", ev.code, ev.message
+                )
         # per-stage latency percentiles from the live histograms — the
         # DATAX-<flow>:Latency-<Stage>-pNN series the dashboard's stat
         # tiles and stage timechart read (obs/histogram.py keeps these
@@ -280,6 +331,15 @@ class StreamingHost:
                     metrics[f"{stem}-p{q}"] = v
         self.telemetry.batch_end(batch_time_ms, {"latencyMs": metrics["Latency-Batch"]})
         self.metric_logger.send_batch_metrics(metrics, batch_time_ms)
+        # alert evaluation AFTER the store flush so window aggregates
+        # include this batch; the firing set rides the health payload
+        # (readyz) and the Alerts_Firing series
+        if self.alerts is not None:
+            firing = self.alerts.evaluate()
+            self.health.record_alerts(firing)
+            self.metric_logger.send_metric(
+                "Alerts_Firing", float(len(firing)), batch_time_ms
+            )
         logger.info(
             "batch %d: %s",
             self.batches_processed + 1,

@@ -499,6 +499,14 @@ class FlowProcessor:
                 sname, schema, self.batch_capacity * 4, location
             )
 
+        # jit re-traces observed since the last collect (UDF-refresh
+        # rebuilds + shape/dictionary-growth cache misses past the
+        # initial trace) — drained into the Retrace_Count metric, the
+        # conformance monitor's DX503 input. The mark is the jit cache
+        # size already accounted for (None = initial trace still due).
+        self.retrace_count = 0
+        self._retrace_mark: Optional[int] = None
+
         self._build_pipeline(output_datasets)
         self._init_device_state()
         self._jit_step()
@@ -1162,6 +1170,11 @@ class FlowProcessor:
         if registry.refresh(batch_time_ms):
             self._build_pipeline(self.output_datasets)
             self._jit_step()  # the old jit closed over the old pipeline
+            # the rebuild discards the compiled step: the re-trace the
+            # next dispatch pays is real work the steady-state model
+            # does not include
+            self.retrace_count += 1
+            self._retrace_mark = None
         if registry.last_errors:
             self.udf_refresh_errors += len(registry.last_errors)
         # whole-second base so device absolute-time math is exact
@@ -1278,6 +1291,30 @@ class FlowProcessor:
 
     def _bump_transfer_stat(self, key: str) -> None:
         self.transfer_stats[key] = self.transfer_stats.get(key, 0) + 1
+
+    # -- retrace accounting ------------------------------------------------
+    def _step_cache_size(self) -> Optional[int]:
+        try:
+            return int(self._step._cache_size())
+        except Exception:  # noqa: BLE001 — accounting only, never fails a batch
+            return None
+
+    def drain_retraces(self) -> int:
+        """Jit re-traces since the last drain: explicit rebuilds
+        (UDF refresh) plus jit-cache growth past the mark. The initial
+        trace is expected — only growth BEYOND the accounted cache size
+        counts (a dictionary-table resize or an input-shape change that
+        silently re-traced the step)."""
+        cur = self._step_cache_size()
+        if cur is not None:
+            if self._retrace_mark is None:
+                self._retrace_mark = cur  # first trace: modeled, not drift
+            elif cur > self._retrace_mark:
+                self.retrace_count += cur - self._retrace_mark
+                self._retrace_mark = cur
+        n = self.retrace_count
+        self.retrace_count = 0
+        return n
 
     def commit(self) -> None:
         """Commit state-table pointers after sinks succeed."""
@@ -1586,6 +1623,11 @@ class PendingBatch:
         if proc.udf_refresh_errors:
             metrics["UdfRefreshError"] = float(proc.udf_refresh_errors)
             proc.udf_refresh_errors = 0
+        # jit re-traces since the last collect (refresh rebuilds +
+        # cache-miss growth) — the conformance monitor's DX503 input
+        retraces = proc.drain_retraces()
+        if retraces:
+            metrics["Retrace_Count"] = float(retraces)
         # sized-transfer accounting: bytes actually moved D2H for this
         # batch and the valid/transferred row ratio (1.0 = wire minimum)
         if names:

@@ -11,6 +11,12 @@ Args (key=value):
   authfile=          gateway auth table JSON (token -> user/roles)
   ingest=0           metrics-ingestor TCP port (0 = off)
   scheduler=0        batch scheduler tick seconds (0 = off)
+  tracefile=<root>/telemetry.jsonl
+                     control-plane flight recorder; REST requests become
+                     rest/<route> traces and spawned jobs join them
+                     (datax.job.process.telemetry.parenttrace), so
+                     `obs trace` renders one tree from the submit to its
+                     batch spans. tracefile=off disables.
   objectstore=       design/runtime configs in a shared object store:
                      an endpoint URL (http://host:port) to use an
                      external store, or serve:<port> to also run the
@@ -53,6 +59,21 @@ def main(argv=None):
     port = int(args.get("port", "5000"))
     web_port = int(args.get("web", "0") or 0)
     env_tokens = {}
+    # end-to-end trace propagation: the control plane records REST
+    # request spans into a flight recorder, generated confs point jobs
+    # at the SAME file, and each submit hands its trace position to the
+    # spawned host — one `obs trace` tree from designer click to batch
+    tracefile = args.get("tracefile", f"{root}/telemetry.jsonl")
+    tracer = None
+    if tracefile and tracefile != "off":
+        from ..obs.telemetry import JsonlWriter, LogWriter, TelemetryLogger
+        from ..obs.tracing import Tracer
+
+        tracer = Tracer(TelemetryLogger(
+            "DataX-ControlPlane", [LogWriter(), JsonlWriter(tracefile)]
+        ))
+        env_tokens["telemetryTraceFile"] = tracefile
+        log.info("control-plane flight recorder: %s", tracefile)
     if web_port:
         # jobs POST metrics to the website in one-box mode
         # (the localMetricsHttpEndpoint wiring, DeploymentLocal samples)
@@ -113,7 +134,8 @@ def main(argv=None):
         fleet_admission=args.get("admission", "true") != "false",
     )
     api = DataXApi(
-        flow_ops, require_roles=args.get("roles", "false") == "true"
+        flow_ops, require_roles=args.get("roles", "false") == "true",
+        tracer=tracer,
     )
     service = DataXApiService(api, port=port)
     service.start()

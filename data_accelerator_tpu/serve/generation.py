@@ -89,6 +89,7 @@ class RuntimeConfigGeneration:
             self._s500_resolve,
             self._s550_batch,
             self._s600_job_configs,
+            self._s620_conformance,
             self._s650_flatten,
             self._s700_write_files,
             self._s800_jobs,
@@ -474,6 +475,43 @@ class RuntimeConfigGeneration:
             job_configs.append((job_name, resolved, jt))
         ctx["job_configs"] = job_configs
 
+    def _s620_conformance(self, ctx) -> None:
+        """Embed the flow's machine-readable cost-model report and the
+        default alert rules into the generated conf, making the DX2xx
+        static prediction a *runtime artifact* the host's
+        ConformanceMonitor and AlertEngine read
+        (``datax.job.process.conformance.model`` /
+        ``datax.job.process.alerts.rules``; obs/conformance.py,
+        obs/alerts.py).
+
+        Fail-open: the conformance model rides on the device analyzer
+        (the same lowering the job will run); an analyzer error must
+        not block deployment — the job simply runs unmonitored, like
+        every job did before this layer existed. Opt out with designer
+        jobconfig ``jobConformanceModel: "false"``."""
+        doc = ctx["doc"]
+        jobconf = (doc["gui"].get("process") or {}).get("jobconfig") or {}
+        ctx["conformance_json"] = None
+        if str(jobconf.get("jobConformanceModel", "")).lower() != "false":
+            try:
+                from ..analysis import analyze_flow_device
+
+                report = analyze_flow_device(doc)
+                if report.stages:
+                    ctx["conformance_json"] = json.dumps(
+                        report.runtime_model(), separators=(",", ":")
+                    )
+            except Exception as e:  # noqa: BLE001 — monitoring is optional
+                logger.warning(
+                    "conformance model generation failed for %s: %s",
+                    doc.get("name"), e,
+                )
+        from ..obs.alerts import default_rules
+
+        ctx["alert_rules_json"] = json.dumps(
+            default_rules(doc.get("name")), separators=(",", ":")
+        )
+
     def _s650_flatten(self, ctx) -> None:
         """Flatten each resolved job config JSON to flat conf text
         (S650 ConfigFlattener.Flatten)."""
@@ -493,6 +531,18 @@ class RuntimeConfigGeneration:
             if jt.get("jobObservabilityPort"):
                 extra["datax.job.process.observability.port"] = str(
                     jt.get("jobObservabilityPort"))
+            if jt.get("telemetryTraceFile"):
+                # one flight recorder for control plane + jobs (the
+                # env-token wiring serve/__main__ uses so `obs trace`
+                # renders the whole cross-process tree from one file)
+                extra["datax.job.process.telemetry.tracefile"] = str(
+                    jt.get("telemetryTraceFile"))
+            if ctx.get("conformance_json"):
+                extra["datax.job.process.conformance.model"] = (
+                    ctx["conformance_json"])
+            if ctx.get("alert_rules_json"):
+                extra["datax.job.process.alerts.rules"] = (
+                    ctx["alert_rules_json"])
             for b_i, b in enumerate(ctx.get("batch_inputs") or []):
                 ns = f"datax.job.input.batch.blob.{b_i}"
                 for k, v in b.items():

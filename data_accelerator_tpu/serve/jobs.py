@@ -29,6 +29,7 @@ import sys
 import time
 from typing import Dict, List, Optional
 
+from ..obs import tracing
 from .storage import JobRegistry
 
 logger = logging.getLogger(__name__)
@@ -165,7 +166,12 @@ class FleetAdmissionGate:
         doc = self.design.get_by_name(flow_name) if flow_name else None
         if doc is None:
             return job  # no flow doc to analyze (bare job record)
-        report = self.plan(candidate_doc=doc, exclude_flow=flow_name)
+        with tracing.span("admission", job=job.get("name"), flow=flow_name):
+            return self._admit_traced(job, doc, flow_name)
+
+    def _admit_traced(self, job: dict, doc: dict, flow_name: str) -> dict:
+        with tracing.span("placement"):
+            report = self.plan(candidate_doc=doc, exclude_flow=flow_name)
         gating = [
             d for d in report.diagnostics
             if d.code in ADMISSION_GATE_CODES
@@ -296,6 +302,14 @@ class LocalJobClient(TpuJobClient):
         ]
         if job.get("batches"):
             cmd.append(f"batches={job['batches']}")
+        if job.get("parentTrace"):
+            # cross-process trace propagation: the spawned host's batch
+            # traces JOIN the control-plane request trace (CLI key=value
+            # args merge into the conf dictionary, ConfigManager)
+            cmd.append(
+                "datax.job.process.telemetry.parenttrace="
+                f"{job['parentTrace']}"
+            )
         env = {**os.environ, **self.env}
         stdout = subprocess.DEVNULL
         if self.log_dir:
@@ -487,6 +501,12 @@ class K8sJobClient(TpuJobClient):
             container["args"] = [f"conf={job['confPath']}"]
         if job.get("batches"):
             container["args"].append(f"batches={job['batches']}")
+        if job.get("parentTrace") and container.get("args"):
+            # same key=value conf-override contract as the local client
+            container["args"].append(
+                "datax.job.process.telemetry.parenttrace="
+                f"{job['parentTrace']}"
+            )
         return manifest
 
     def _jobs_url(self, name: Optional[str] = None) -> str:
@@ -656,7 +676,14 @@ class JobOperation:
             # raises FleetAdmissionError (recording the rejection on the
             # registry record) before the client spawns anything
             job = self.admission_gate.admit(job)
-        job = self.client.submit(job)
+        with tracing.span("submit", job=job_name):
+            # hand the active trace position (the REST request's span
+            # tree, when the control plane traces) to the spawned host:
+            # its batch spans then root under this submit
+            parent = tracing.format_parent(tracing.capture())
+            if parent is not None:
+                job["parentTrace"] = parent
+            job = self.client.submit(job)
         self.registry.upsert(job)
         self._notify_replanner()
         return job

@@ -24,6 +24,7 @@ failure. Run: ``python -m data_accelerator_tpu.serve [port=5000]``.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import re
@@ -33,6 +34,7 @@ from typing import Callable, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from ..compile.codegen import CodegenEngine
+from ..obs import tracing
 from .flowservice import FlowOperation
 from .jobs import FleetAdmissionError
 from .livequery import KernelService
@@ -60,7 +62,14 @@ class DataXApi:
         flow_ops: FlowOperation,
         kernels: Optional[KernelService] = None,
         require_roles: bool = False,
+        tracer: Optional[tracing.Tracer] = None,
     ):
+        # control-plane request tracing: each dispatched route becomes a
+        # `rest/<path>` trace whose id flows through job submit ->
+        # admission -> spawned host conf (telemetry.parenttrace), so the
+        # flight recorder can show one tree from the designer click to
+        # the batch spans it caused. None = tracing off (default).
+        self.tracer = tracer
         self.flow_ops = flow_ops
         self.kernels = kernels or KernelService(
             runtime_storage=flow_ops.runtime
@@ -132,8 +141,24 @@ class DataXApi:
                 return 401, {"error": {"message": "caller has no DataX role"}}
             if needs_writer and ROLE_WRITER not in roles:
                 return 403, {"error": {"message": "writer role required"}}
+        ctx = (
+            self.tracer.begin(f"rest/{path}", method=method.upper())
+            if self.tracer is not None else None
+        )
+        status, payload = self._dispatch_traced(
+            handler, ctx, method, path, body, query
+        )
+        if ctx is not None:
+            ctx.end(status=status)
+        return status, payload
+
+    def _dispatch_traced(
+        self, handler, ctx, method, path, body, query,
+    ) -> Tuple[int, dict]:
         try:
-            result = handler(body or {}, query or {})
+            with (ctx.activate() if ctx is not None
+                  else contextlib.nullcontext()):
+                result = handler(body or {}, query or {})
             return 200, {"result": result}
         except ApiError as e:
             return e.status, {"error": {"message": str(e)}}

@@ -43,7 +43,13 @@ class PlacementReplanner:
         self.replans = 0
 
     def on_job_event(self):
-        report = self.gate.replan()
+        from ..obs import tracing
+
+        # a child span of the active REST request trace when the
+        # re-plan was caused by a traced start/stop; a no-op from the
+        # scheduler's own tick thread
+        with tracing.span("scheduler/replan"):
+            report = self.gate.replan()
         self.replans += 1
         try:
             self.gate.metrics.send_metric(

@@ -24,6 +24,10 @@ TPU-native stand-in: one ThreadingHTTPServer.
                               latency histograms + latest gauge values;
                               obs/exposition.py renders it, same dialect
                               as every runtime host's own endpoint).
+- ``/alerts``               — alert rules + firing set of every
+                              registered AlertEngine (``?flow=`` to
+                              filter); the SPA's firing-alert
+                              annotations poll this.
 - ``/healthz``, ``/readyz`` — liveness/readiness probes for the website
                               process itself.
 - ``/composition``          — page registry (web.composition.json role).
@@ -82,6 +86,7 @@ class WebsiteServer:
         host: str = "127.0.0.1",
         port: int = 0,
         static_dir: Optional[str] = None,
+        alerts=None,
     ):
         if api is None and gateway_url is None:
             raise ValueError("need an in-process api or a gateway_url")
@@ -90,6 +95,9 @@ class WebsiteServer:
         self.gateway_token = gateway_token
         self.store = store if store is not None else METRIC_STORE
         self.static_dir = static_dir or STATIC_DIR
+        # obs.alerts.AlertEngine instances (one per flow) whose firing
+        # sets the SPA annotates; register_alerts() adds more at runtime
+        self.alert_engines = list(alerts or [])
         ws = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -147,6 +155,20 @@ class WebsiteServer:
                         200, body,
                         "text/plain; version=0.0.4; charset=utf-8",
                     )
+                elif path == "/alerts":
+                    q = parse_qs(parsed.query)
+                    flow = (q.get("flow") or [""])[0]
+                    snaps = [
+                        e.snapshot() for e in ws.alert_engines
+                        if not flow or e.flow == flow
+                    ]
+                    self._send_json(200, {
+                        "alerts": snaps,
+                        "firing": [
+                            {**a, "flow": s["flow"]}
+                            for s in snaps for a in s["firing"]
+                        ],
+                    })
                 elif path == "/healthz":
                     self._send_json(200, {"status": "ok", "role": "website"})
                 elif path == "/readyz":
@@ -285,6 +307,12 @@ class WebsiteServer:
         return self.api.dispatch(
             method, rest, body=parsed_body, query=parse_qs(query)
         )
+
+    def register_alerts(self, engine) -> None:
+        """Register a flow's AlertEngine with the website's ``/alerts``
+        surface (one-box hosts running in-process do this; remote hosts
+        serve their own /alerts on the observability port)."""
+        self.alert_engines.append(engine)
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> None:

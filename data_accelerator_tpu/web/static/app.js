@@ -787,6 +787,12 @@ route("#/metrics", async (view, hash) => {
 
   const prefix = `DATAX-${flow}:`;
 
+  /* firing-alert annotations: poll the alert engine's /alerts surface
+     (obs/alerts.py) — a banner lists firing rules, and any tile/chart
+     whose metric a firing rule watches gets the alerting outline */
+  const alertBox = h("div", {});
+  view.append(alertBox);
+
   /* latency percentile stat tiles (whole-batch p50/p95/p99, live from
      the engine's per-stage histograms) + per-stage p95 timechart */
   const pctlTiles = h("div", { class: "tiles" });
@@ -859,6 +865,35 @@ route("#/metrics", async (view, hash) => {
     const metric = k.slice(prefix.length);
     return LATENCY_PCTL_RE.test(metric) ? seedLatency(metric) : ensure(metric);
   }));
+
+  const alertedMetrics = new Set();
+  async function pollAlerts() {
+    let payload;
+    try {
+      payload = await fetch(`/alerts?flow=${encodeURIComponent(flow)}`)
+        .then((r) => (r.ok ? r.json() : null));
+    } catch { return; }
+    if (!payload) return;
+    const firing = payload.firing || [];
+    alertBox.replaceChildren();
+    alertedMetrics.clear();
+    if (firing.length) {
+      alertBox.append(h("div", { class: "card alert-firing" },
+        h("div", { class: "chart-title" },
+          `⚠ ${firing.length} alert(s) firing`),
+        firing.map((a) => h("div", { class: "alert-row" },
+          h("span", { class: "mono" }, `${a.severity || "warn"}: ${a.name}`),
+          ` — ${a.description || a.metric || ""}`))));
+      for (const a of firing) if (a.metric) alertedMetrics.add(a.metric);
+    }
+    for (const [metric, el] of Object.entries(tileEls)) {
+      const tile = el.closest(".tile");
+      if (tile) tile.classList.toggle("alerting", alertedMetrics.has(metric));
+    }
+  }
+  pollAlerts();
+  const alertTimer = setInterval(pollAlerts, 5000);
+  liveFeeds.push({ close: () => clearInterval(alertTimer) });
 
   const es = new EventSource(`/metrics/stream?prefix=${encodeURIComponent(prefix)}`);
   liveFeeds.push(es);

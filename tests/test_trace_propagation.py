@@ -1,0 +1,237 @@
+"""End-to-end trace propagation: a control-plane REST request's trace
+flows through job submit -> admission gate -> spawned host conf
+(``datax.job.process.telemetry.parenttrace``), so the flight recorder
+holds ONE trace spanning REST submit -> admission -> host batch spans,
+and ``obs trace`` renders the cross-process tree."""
+
+import json
+import os
+
+import pytest
+
+from data_accelerator_tpu.core.confmanager import ConfigManager
+from data_accelerator_tpu.obs import tracing
+from data_accelerator_tpu.obs.__main__ import load_spans, main as obs_main
+from data_accelerator_tpu.obs.telemetry import JsonlWriter, TelemetryLogger
+from data_accelerator_tpu.obs.tracing import Tracer
+from data_accelerator_tpu.serve.flowservice import FlowOperation
+from data_accelerator_tpu.serve.jobs import JobState, TpuJobClient
+from data_accelerator_tpu.serve.restapi import DataXApi
+from data_accelerator_tpu.serve.scenarios import probe_deploy_gui
+from data_accelerator_tpu.serve.storage import (
+    LocalDesignTimeStorage,
+    LocalRuntimeStorage,
+)
+
+FLOW = "probe-deploy"
+
+
+class CaptureClient(TpuJobClient):
+    """Records submits without spawning (the job dict carries
+    parentTrace, which is what this suite inspects)."""
+
+    def __init__(self):
+        self.submitted = []
+
+    def submit(self, job):
+        self.submitted.append(dict(job))
+        job["state"] = JobState.Starting
+        job["clientId"] = 4242
+        return job
+
+    def stop(self, job):
+        job["state"] = JobState.Idle
+        return job
+
+    def get_state(self, job):
+        return job.get("state") or JobState.Idle
+
+
+@pytest.fixture
+def stack(tmp_path):
+    """Control plane with request tracing into a flight-recorder file
+    shared with generated jobs (the serve/__main__ one-box wiring)."""
+    trace_file = str(tmp_path / "telemetry.jsonl")
+    flow_ops = FlowOperation(
+        LocalDesignTimeStorage(str(tmp_path / "design")),
+        LocalRuntimeStorage(str(tmp_path / "runtime")),
+        job_client=CaptureClient(),
+        env_tokens={"telemetryTraceFile": trace_file},
+    )
+    tracer = Tracer(TelemetryLogger(
+        "DataX-ControlPlane", [JsonlWriter(trace_file)]
+    ))
+    api = DataXApi(flow_ops, tracer=tracer)
+    return api, flow_ops, trace_file
+
+
+def _deploy(api):
+    status, r = api.dispatch("POST", "flow/save", probe_deploy_gui())
+    assert status == 200, r
+    status, r = api.dispatch(
+        "POST", "flow/generateconfigs", {"flowName": FLOW}
+    )
+    assert status == 200, r
+    return r["result"]["confPaths"][0]
+
+
+def test_submit_carries_request_trace_to_client(stack):
+    api, flow_ops, trace_file = stack
+    _deploy(api)
+    status, r = api.dispatch(
+        "POST", "flow/startjobs", {"flowName": FLOW, "batches": 2}
+    )
+    assert status == 200, r
+    [job] = flow_ops.jobs.client.submitted
+    parent = tracing.parse_parent(job.get("parentTrace"))
+    assert parent is not None, job
+
+    spans = load_spans(trace_file)
+    by_name = {s["name"]: s for s in spans}
+    start_root = by_name["rest/flow/startjobs"]
+    # the job's parent trace IS the startjobs request's trace, anchored
+    # at the submit span (a descendant of the request root)
+    assert parent[0] == start_root["trace"]
+    submit = by_name["submit"]
+    assert parent[1] == submit["span"]
+    # admission + placement + submit + replan all belong to the request
+    for name in ("admission", "placement", "submit", "scheduler/replan"):
+        assert by_name[name]["trace"] == start_root["trace"], name
+
+
+def test_local_client_passes_parenttrace_conf_override(tmp_path, monkeypatch):
+    """LocalJobClient forwards the captured trace position as a
+    key=value conf override on the spawned host's command line."""
+    from data_accelerator_tpu.serve import jobs as jobs_mod
+    from data_accelerator_tpu.serve.jobs import LocalJobClient
+
+    calls = []
+
+    class P:
+        pid = 4242
+
+        def poll(self):
+            return None
+
+    monkeypatch.setattr(
+        jobs_mod.subprocess, "Popen",
+        lambda cmd, **kw: calls.append(cmd) or P(),
+    )
+    client = LocalJobClient()
+    client.submit({
+        "name": "j1", "confPath": "/tmp/x.conf",
+        "parentTrace": "abc-123:4",
+    })
+    [cmd] = calls
+    assert "datax.job.process.telemetry.parenttrace=abc-123:4" in cmd
+    # without a parentTrace the arg is absent (standalone starts)
+    client.submit({"name": "j2", "confPath": "/tmp/x.conf"})
+    assert not any("parenttrace" in a for a in calls[1])
+
+
+def test_k8s_manifest_carries_parenttrace(tmp_path):
+    from data_accelerator_tpu.serve.jobs import K8sJobClient
+
+    client = K8sJobClient(api_server="https://k8s.example")
+    manifest = client.render_manifest({
+        "name": "j1", "confPath": "/conf/x.conf",
+        "parentTrace": "abc-123:4",
+    })
+    args = manifest["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "datax.job.process.telemetry.parenttrace=abc-123:4" in args
+
+
+def test_submit_to_batch_single_trace(stack):
+    """Acceptance: REST submit -> admission -> host batch spans form a
+    single trace, and `obs trace <trace_id>` renders the whole tree
+    from the shared flight recorder."""
+    from data_accelerator_tpu.runtime.host import StreamingHost
+
+    api, flow_ops, trace_file = stack
+    conf_path = _deploy(api)
+    status, r = api.dispatch(
+        "POST", "flow/startjobs", {"flowName": FLOW, "batches": 2}
+    )
+    assert status == 200, r
+    [job] = flow_ops.jobs.client.submitted
+
+    # run the host exactly as the spawned process would: conf file +
+    # the parenttrace CLI override LocalJobClient appends
+    ConfigManager.reset()
+    ConfigManager.get_configuration_from_arguments([
+        f"conf={conf_path}",
+        "datax.job.process.telemetry.parenttrace="
+        f"{job['parentTrace']}",
+    ])
+    conf = ConfigManager.load_config()
+    host = StreamingHost(conf)
+    try:
+        host.run(max_batches=2)
+    finally:
+        host.stop()
+        ConfigManager.reset()
+
+    trace_id, submit_span = tracing.parse_parent(job["parentTrace"])
+    spans = [s for s in load_spans(trace_file) if s["trace"] == trace_id]
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    # the one trace holds the REST request, the admission decision and
+    # every batch root the job produced
+    assert "rest/flow/startjobs" in by_name
+    assert "admission" in by_name
+    roots = by_name["streaming/batch"]
+    assert len(roots) == 2
+    for root in roots:
+        assert root["trace"] == trace_id
+        assert root["parent"] == submit_span
+    # span ids are unique across the whole cross-process trace
+    ids = [s["span"] for s in spans]
+    assert len(ids) == len(set(ids))
+    # batch stage spans parent under their own batch root, not the
+    # control plane
+    root_ids = {r["span"] for r in roots}
+    assert all(s["parent"] in root_ids for s in by_name["decode"])
+
+    # the CLI renders the cross-process tree for the trace id AND finds
+    # the same trace by batch id
+    rc = obs_main(["trace", trace_id, "--file", trace_file])
+    assert rc == 0
+    batch_id = str(roots[0]["properties"]["batchTime"])
+    rc = obs_main(["trace", batch_id, "--file", trace_file])
+    assert rc == 0
+
+
+def test_trace_cli_renders_cross_process_tree(stack, capsys):
+    """The rendered tree nests host batch spans under the control-plane
+    submit span."""
+    from data_accelerator_tpu.runtime.host import StreamingHost
+
+    api, flow_ops, trace_file = stack
+    conf_path = _deploy(api)
+    api.dispatch("POST", "flow/startjobs", {"flowName": FLOW, "batches": 1})
+    [job] = flow_ops.jobs.client.submitted
+    ConfigManager.reset()
+    ConfigManager.get_configuration_from_arguments([
+        f"conf={conf_path}",
+        f"datax.job.process.telemetry.parenttrace={job['parentTrace']}",
+    ])
+    conf = ConfigManager.load_config()
+    host = StreamingHost(conf)
+    try:
+        host.run(max_batches=1)
+    finally:
+        host.stop()
+        ConfigManager.reset()
+    trace_id, _ = tracing.parse_parent(job["parentTrace"])
+    capsys.readouterr()
+    assert obs_main(["trace", trace_id, "--file", trace_file]) == 0
+    out = capsys.readouterr().out
+    assert "rest/flow/startjobs" in out
+    assert "admission" in out
+    assert "streaming/batch" in out
+    # the batch root is NESTED under the request (tree-prefixed line,
+    # not a top-level root) — the cross-process parent link held
+    for line in out.splitlines():
+        if "streaming/batch" in line:
+            assert not line.startswith("streaming/batch"), out
