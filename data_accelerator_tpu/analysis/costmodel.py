@@ -96,6 +96,28 @@ def d2h_transfer_bytes(
     return view_output_bytes(types, plan, rows_transferred)
 
 
+# donated double-buffered output transfer slots
+# (runtime/processor.py _stage_output): each output dataset keeps this
+# many transfer-ready copies of its table resident in HBM, alternating
+# A/B so batch N+1's jitted pack never clobbers batch N's in-flight
+# background D2H copy
+OUTPUT_SLOT_BUFFERS = 2
+
+
+def output_slot_bytes(
+    types: Dict[str, str], plan: Optional[StagePlan], capacity: int
+) -> int:
+    """Closed-form HBM bytes of one output's donated transfer slots:
+    ``OUTPUT_SLOT_BUFFERS`` resident copies of the view-output layout
+    at the slot capacity. The runtime sizes slots at the adaptive
+    (EWMA-bucketed) transfer capacity, bounded above by the padded
+    output capacity — the static model charges the bound, like every
+    other capacity it accounts. These bytes are persistent (the slots
+    live as long as the flow), so they join the DX2xx/DX4xx HBM totals
+    the fleet placer packs against."""
+    return OUTPUT_SLOT_BUFFERS * view_output_bytes(types, plan, capacity)
+
+
 def runtime_conformance_model(
     totals: Dict[str, object],
     stages: Optional[list] = None,

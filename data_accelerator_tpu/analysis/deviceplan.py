@@ -56,7 +56,9 @@ from ..runtime.timewindow import num_slots
 from ..serve.flowbuilder import RuleDefinitionGenerator
 from .costmodel import (
     DEFAULT_MATCH_MATRIX_BUDGET,
+    OUTPUT_SLOT_BUFFERS,
     d2h_transfer_bytes,
+    output_slot_bytes,
     row_bytes,
     stage_flops,
     stage_ici_bytes,
@@ -82,8 +84,9 @@ D2H_OVERSIZE_FACTOR = 64
 _STRUCT_DTYPES = {"double": jnp.float32, "boolean": jnp.bool_}
 
 # stage kinds that persist across batches (device-resident state) vs
-# materialized per batch
-PERSISTENT_KINDS = ("ring", "state", "refdata")
+# materialized per batch; "outslot" = the donated double-buffered
+# output transfer slots the runtime keeps resident per output
+PERSISTENT_KINDS = ("ring", "state", "refdata", "outslot")
 
 
 def table_struct(schema: ViewSchema, rows: int) -> TableData:
@@ -779,6 +782,25 @@ def _stage_walk(
                 view.schema.types, view.plan, view.capacity
             )
         stages.append(stage)
+        if view.name in plan.output_datasets:
+            # the donated double-buffered transfer slots the runtime
+            # keeps resident for this output (runtime/processor.py
+            # _stage_output): OUTPUT_SLOT_BUFFERS copies of the output
+            # layout, persistent HBM the placer must pack. Lowered
+            # bytes derive from the same evaluated table as the view
+            # stage, so model == lowering stays exact.
+            stages.append(StageCost(
+                name=f"outslot:{view.name}", kind="outslot",
+                rows=view.capacity,
+                hbm_bytes=OUTPUT_SLOT_BUFFERS * _table_data_bytes(out),
+                model_bytes=output_slot_bytes(
+                    view.schema.types, view.plan, view.capacity
+                ),
+                detail=(
+                    f"{OUTPUT_SLOT_BUFFERS}x donated transfer slots "
+                    f"(A/B double buffer)"
+                ),
+            ))
     return stages
 
 
@@ -844,15 +866,21 @@ def _lint(
                     per_batch = d2h_transfer_bytes(
                         view.schema.types, p, view.capacity
                     )
+                    slot_bytes = output_slot_bytes(
+                        view.schema.types, p, view.capacity
+                    )
                     diags.append(make(
                         "DX206", view.name,
                         f"output capacity {view.capacity} exceeds the "
                         f"modeled group count {product} by more than "
                         f"{D2H_OVERSIZE_FACTOR}x: a full fetch moves "
                         f"{per_batch} D2H bytes/batch of mostly padding "
-                        f"through the sync stage; sized output transfer "
+                        f"through the sync stage, and the "
+                        f"{OUTPUT_SLOT_BUFFERS}x donated transfer slots "
+                        f"pin {slot_bytes} HBM bytes at that padding; "
+                        f"sized output transfer "
                         f"(process.pipeline.sizedtransfer, default on) "
-                        f"or a tighter process.maxgroups shrinks it to "
+                        f"or a tighter process.maxgroups shrinks both to "
                         f"the wire minimum",
                     ))
         for s in p.joins:

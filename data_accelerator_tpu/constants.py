@@ -111,10 +111,18 @@ class MetricName:
         r"Pipeline_Stall_Ms",
         # sized output transfer (runtime/processor.py PendingBatch):
         # D2H bytes per batch, valid/transferred row ratio, and the
-        # async-copy-capability / sized-cap-overflow fallback counters
+        # async-copy-capability / sized-cap-overflow / slot-contention
+        # fallback counters
         r"Transfer_D2HBytes",
         r"Transfer_Efficiency",
-        r"Transfer_(AsyncCopyFallback|Overflow)_Count",
+        r"Transfer_(AsyncCopyFallback|Overflow|SlotContended)_Count",
+        # device-resident result path (runtime/processor.py
+        # collect_counts + runtime/host.py background landing): bytes
+        # the blocking counts-only sync moved, landings still queued
+        # when a batch's tail was submitted to the background transfer
+        # thread, and the ms its streamed tables took to resolve there
+        r"Sync_CountsBytes",
+        r"Transfer_Background_(Pending|LandMs)",
         # jit re-traces observed since the last collect (UDF refresh
         # rebuilds + shape/dictionary-growth cache misses); the
         # conformance monitor's DX503 input

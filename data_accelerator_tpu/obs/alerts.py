@@ -187,6 +187,20 @@ def default_rules(flow: Optional[str] = None) -> List[dict]:
                            "window's oldest batch",
         },
         {
+            # pending landings sustained above the default pipeline
+            # depth (process.pipeline.depth, default 2): the background
+            # transfer thread can't keep up with the dispatch loop, so
+            # backpressure is about to serialize the pipeline
+            "name": "background-transfer-backlog",
+            "metric": "Transfer_Background_Pending",
+            "op": ">", "threshold": 2.0, "aggregate": "avg",
+            "windowSeconds": 120, "forSeconds": 20,
+            "severity": "warn",
+            "description": "background result landings queuing beyond "
+                           "the pipeline depth — sinks or D2H transfers "
+                           "are slower than the dispatch loop",
+        },
+        {
             "name": "batch-error-burn",
             "slo": {"objective": 0.99}, "burnRate": 2.0,
             "windowSeconds": 300,

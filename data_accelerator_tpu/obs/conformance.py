@@ -27,6 +27,15 @@ a sustained drift is one event, not one per batch; the cumulative
 ``Conformance_Drift_Count`` gauge keeps the total visible. This is the
 observability substrate ROADMAP item 5's controller reads: you cannot
 act on drift you cannot see.
+
+Device-resident result path note: with background transfer
+(``process.pipeline.backgroundtransfer``) ``observe()`` is called from
+the host's landing thread, one call per batch finish in strict FIFO
+order — the windowed series it judges (``Transfer_D2HBytes``, which
+includes the counts vector's ``Sync_CountsBytes``, per-output
+occupancy, retraces) are unchanged by the split, and the modeled
+``d2hBytesPerBatch`` it compares against stays a wire-bytes term (the
+donated output-slot HBM lives in the model's ``hbmBytes``, not here).
 """
 
 from __future__ import annotations

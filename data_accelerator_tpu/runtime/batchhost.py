@@ -191,44 +191,93 @@ class BatchHost:
         ``process.pipeline.depth`` chunks stay in flight (the
         generalized P6 overlap shared with
         ``StreamingHost.run_pipelined``); finishes are strictly FIFO so
-        state-table commits happen in chunk order.
+        state-table commits happen in chunk order. With
+        ``process.pipeline.backgroundtransfer`` (default on) a finish
+        blocks only on the chunk's counts vector — the streamed output
+        tables land and sinks run on a dedicated background landing
+        worker (still FIFO: one worker, submission order), so file
+        reads and device steps keep flowing while results land. A
+        landing failure aborts the pass before the tracker file is
+        written, so every file is reprocessed on rerun (at-least-once).
         """
         from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
 
         self.telemetry.track_event("datax/batch/app/begin")
         t0 = time.time()
         files = self.list_files_to_process()
         cap = self.processor.batch_capacity
         depth = max(1, self.processor.pipeline_depth)
+        background = (
+            (self.dict.get_sub_dictionary("datax.job.process.pipeline.")
+             .get_or_else("backgroundtransfer", "true") or "")
+            .lower() != "false"
+        ) and self.processor.mesh is None
         totals: Dict[str, float] = {"Batch_Files_Count": float(len(files))}
         batch_time_ms = int(t0 * 1000)
         pending = deque()  # FIFO window of (handle, trace) in flight
+        landings = deque()  # futures of chunk tails on the landing worker
+        land_pool = (
+            ThreadPoolExecutor(1, thread_name_prefix="landing")
+            if background else None
+        )
+        landing_failed: List[BaseException] = []
 
-        def finish(handle, trace) -> None:
-            with trace.activate():
-                with tracing.span("sync"):
-                    handle.block_until_evaluated()
-                trace.record_since("device-step", "dispatch-done")
-                with tracing.span("collect"):
-                    datasets, metrics = handle.collect()
-                with tracing.span("sinks"):
-                    self.dispatcher.dispatch(datasets, batch_time_ms)
-            self.processor.commit()
-            trace.end()
+        def land(handle, trace) -> None:
+            """The chunk tail behind the counts sync: resolve streamed
+            tables, sinks, commit. Runs on the landing worker (or
+            inline when background transfer is off)."""
+            if landing_failed:
+                handle.abandon()
+                trace.end(status="aborted")
+                return
+            try:
+                with trace.activate():
+                    with tracing.span("collect"):
+                        datasets, metrics = handle.collect_tables()
+                    with tracing.span("sinks"):
+                        self.dispatcher.dispatch(datasets, batch_time_ms)
+                self.processor.commit()
+                trace.end()
+            except Exception as e:  # noqa: BLE001 — re-raised on the main pass
+                trace.end(status="error")
+                handle.abandon()
+                landing_failed.append(e)
+                return
             for k, v in metrics.items():
                 # counts sum across chunks; point-in-time / per-chunk
                 # latency values don't (a pipelined chunk's
                 # dispatch->collect span absorbs the NEXT chunk's file
                 # reads, and summing an epoch timestamp is meaningless)
                 if k in ("Latency-Process", "BatchProcessedET",
-                         "Transfer_Efficiency", "Pipeline_Depth"):
+                         "Transfer_Efficiency", "Pipeline_Depth",
+                         "Transfer_Background_Pending",
+                         "Transfer_Background_LandMs"):
                     continue
                 totals[k] = totals.get(k, 0.0) + float(v)
+
+        def check_landing_failure() -> None:
+            if landing_failed:
+                raise landing_failed[0]
+
+        def finish(handle, trace) -> None:
+            # counts-only sync on the main pass — the chunk's single
+            # blocking device read; the tail lands out-of-band
+            with trace.activate():
+                with tracing.span("sync"):
+                    handle.collect_counts()
+                trace.record_since("device-step", "dispatch-done")
+            if land_pool is not None:
+                landings.append(land_pool.submit(land, handle, trace))
+            else:
+                land(handle, trace)
+                check_landing_failure()
 
         def flush(chunk: List[dict]):
             # dispatch chunk N; once `depth` chunks are in flight,
             # finish the oldest while the newer ones compute — file
             # reads and sink writes hide under the device steps
+            check_landing_failure()
             trace = self.tracer.begin("batch/chunk", batchTime=batch_time_ms)
             with trace.activate(), tracing.span("decode", rows=len(chunk)):
                 raw = self.processor.encode_rows(
@@ -240,6 +289,9 @@ class BatchHost:
             pending.append((handle, trace))
             if len(pending) > depth:
                 finish(*pending.popleft())
+            # backpressure: queued landings never outgrow the window
+            while len(landings) > depth:
+                landings.popleft().result()
 
         # linear row buffering: consume via an index instead of
         # re-slicing the tail each chunk (`rows = rows[cap:]` re-copied
@@ -261,11 +313,23 @@ class BatchHost:
                 flush(rows[pos:])
             while pending:
                 finish(*pending.popleft())
+            while landings:
+                landings.popleft().result()
+            check_landing_failure()
         except Exception as e:
             self.telemetry.track_exception(e, {"event": "error/batch/process"})
-            for _h, tr in pending:
+            for h, tr in pending:
                 tr.end(status="error")  # idempotent
+                h.abandon()
+            while landings:  # settle queued tails (post-failure no-ops)
+                try:
+                    landings.popleft().result(timeout=60)
+                except Exception:  # noqa: BLE001 — first failure already raised
+                    pass
             raise
+        finally:
+            if land_pool is not None:
+                land_pool.shutdown(wait=True)
         # tracker written only after a fully successful pass (at-least-once)
         self._processed.update(files)
         if self.tracker_path:

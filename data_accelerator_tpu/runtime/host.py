@@ -16,6 +16,8 @@ import logging
 import sys
 import threading
 import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
 
 from ..constants import MetricName
@@ -206,6 +208,29 @@ class StreamingHost:
         self.batches_processed = 0
         self._stop = False
 
+        # background result landing (the device-resident result path):
+        # in the pipelined loop the only BLOCKING device read per batch
+        # is the packed counts vector; the output tables stream D2H in
+        # the background and the batch tail (collect_tables -> sinks ->
+        # commit -> ack -> metrics -> checkpoint) runs on this dedicated
+        # single-thread landing executor — one worker, so landings stay
+        # strictly FIFO while the dispatch loop keeps feeding the
+        # device. Conf datax.job.process.pipeline.backgroundtransfer
+        # (default on); off under a mesh like sized transfer.
+        pipe_conf = dict_.get_sub_dictionary(
+            SettingNamespace.JobProcessPrefix + "pipeline."
+        )
+        self.background_transfer = (
+            (pipe_conf.get_or_else("backgroundtransfer", "true") or "")
+            .lower() != "false"
+        ) and self.processor.mesh is None
+        self._landing_pool = (
+            ThreadPoolExecutor(1, thread_name_prefix="landing")
+            if self.background_transfer else None
+        )
+        self._landings = deque()  # futures of submitted landings, FIFO
+        self._landing_failed: Optional[BaseException] = None
+
     # -- loop -------------------------------------------------------------
     def _poll_and_encode(self):
         """Poll every source and encode one device batch per source;
@@ -255,30 +280,140 @@ class StreamingHost:
     def _finish(
         self, handle, consumed, batch_time_ms, t0, trace,
         inflight_depth: int = 1,
-    ) -> Dict[str, float]:
-        """Collect a batch and run its tail: sinks -> commit -> ack ->
-        metrics -> checkpoint. Failures requeue un-acked source batches
+        background: bool = False,
+    ) -> Optional[Dict[str, float]]:
+        """Finish a batch. The CALLING thread pays only the counts-only
+        sync (``collect_counts`` — the packed counts vector, a few
+        hundred bytes already streaming since dispatch); the tail
+        (collect tables -> sinks -> commit -> ack -> metrics ->
+        checkpoint) runs inline by default, or — with ``background`` —
+        on the dedicated landing thread so the dispatch loop keeps
+        feeding the device while results land and sinks ack
+        out-of-band. Landings are strictly FIFO (one worker), so
+        state-table commits, acks and offset checkpoints keep dispatch
+        order at every depth. Failures requeue un-acked source batches
         and rethrow so the batch retries, at-least-once
-        (CommonProcessorFactory.scala:382-398). Every stage is a span of
-        the batch's trace and a sample in its stage histogram.
-        ``inflight_depth``: how many batches (this one included) were in
-        flight when the window forced this finish — the live pipeline
-        depth gauge."""
+        (CommonProcessorFactory.scala:382-398); a background landing
+        failure is recorded and re-raised on the dispatch loop, which
+        then requeues the whole window. ``inflight_depth``: how many
+        batches (this one included) were in flight when the window
+        forced this finish — the live pipeline depth gauge. Returns the
+        batch metrics inline, or None when the tail went to the
+        landing thread."""
         stall_ms = 0.0
         try:
+            with trace.activate(), tracing.span("sync"):
+                # the batch's ONLY blocking device read: the counts
+                # vector. The trace separates "rules evaluated"
+                # (device-step ends here) from result transport +
+                # materialization (collect, backgrounded below).
+                sync_t0 = time.time()
+                handle.collect_counts()
+                # time the dispatch loop actually stalled waiting
+                # for the window's oldest batch to leave the device
+                stall_ms = (time.time() - sync_t0) * 1000.0
+            trace.record_since("device-step", "dispatch-done")
+        except Exception as e:
+            self.telemetry.track_exception(
+                e, {"event": "error/streaming/process", "batchTime": batch_time_ms}
+            )
+            self.health.record_batch(
+                batch_time_ms, ok=False, error=f"{type(e).__name__}: {e}"
+            )
+            trace.end(status="error")
+            handle.abandon()
+            if background:
+                # let already-queued (earlier, independent) landings ack
+                # before the requeue, so the un-acked FIFO can't race
+                self._settle_landings()
+            for s in self.sources.values():
+                s.requeue_unacked()
+            logger.exception("batch sync failed; rethrowing for retry")
+            raise
+        if background and self._landing_pool is not None:
+            backlog = self._prune_landings()
+            self._landings.append(self._landing_pool.submit(
+                self._landing_run, handle, consumed, batch_time_ms, t0,
+                trace, inflight_depth, stall_ms, backlog,
+            ))
+            return None
+        return self._finish_tail(
+            handle, consumed, batch_time_ms, t0, trace, inflight_depth,
+            stall_ms, None, requeue_on_error=True,
+        )
+
+    def _landing_run(
+        self, handle, consumed, batch_time_ms, t0, trace,
+        inflight_depth, stall_ms, backlog,
+    ) -> Optional[Dict[str, float]]:
+        """One queued landing on the background transfer thread. After
+        a recorded failure the rest of the queue drains as no-ops —
+        later batches stay un-acked, and the dispatch loop (which
+        re-raises the failure) requeues the whole window."""
+        if self._landing_failed is not None:
+            handle.abandon()
+            trace.end(status="aborted")
+            return None
+        try:
+            return self._finish_tail(
+                handle, consumed, batch_time_ms, t0, trace, inflight_depth,
+                stall_ms, backlog, requeue_on_error=False,
+            )
+        except Exception as e:  # noqa: BLE001 — re-raised on the loop thread
+            self._landing_failed = e
+            handle.abandon()
+            return None
+
+    def _prune_landings(self) -> int:
+        """Drop completed landings from the FIFO; returns the number
+        still pending (the background-transfer backlog gauge)."""
+        while self._landings and self._landings[0].done():
+            self._landings.popleft()
+        return len(self._landings)
+
+    def _wait_landing_backlog(self, depth: int) -> None:
+        """Backpressure: never let pending landings outgrow the
+        pipeline window — a landing thread that can't keep up must
+        stall the dispatch loop, not grow an unbounded queue."""
+        while self._prune_landings() > depth and self._landing_failed is None:
+            try:
+                self._landings[0].result(timeout=60)
+            except Exception:  # noqa: BLE001 — failures surface via the flag
+                pass
+
+    def _check_landing_failure(self) -> None:
+        if self._landing_failed is not None:
+            raise self._landing_failed
+
+    def _drain_landings(self) -> None:
+        """Wait out every queued landing (FIFO), then surface any
+        recorded failure on the calling thread."""
+        while self._landings:
+            self._landings.popleft().result()
+        self._check_landing_failure()
+
+    def _settle_landings(self) -> None:
+        """Cleanup path: wait for queued landings without raising."""
+        while self._landings:
+            try:
+                self._landings.popleft().result(timeout=60)
+            except Exception:  # noqa: BLE001 — cleanup must not mask the cause
+                pass
+
+    def _finish_tail(
+        self, handle, consumed, batch_time_ms, t0, trace,
+        inflight_depth, stall_ms, backlog,
+        requeue_on_error: bool = True,
+    ) -> Dict[str, float]:
+        """The batch tail behind the counts sync: land the
+        background-streamed tables, run sinks, commit state, ack
+        sources, emit metrics/conformance/alerts, checkpoint."""
+        try:
             with trace.activate():
-                with tracing.span("sync"):
-                    # completion handshake first, so the trace separates
-                    # "rules evaluated" (device-step ends here) from
-                    # result transport + materialization (collect)
-                    sync_t0 = time.time()
-                    handle.block_until_evaluated()
-                    # time the dispatch loop actually stalled waiting
-                    # for the window's oldest batch to leave the device
-                    stall_ms = (time.time() - sync_t0) * 1000.0
-                trace.record_since("device-step", "dispatch-done")
+                land_t0 = time.time()
                 with tracing.span("collect"):
-                    datasets, metrics = handle.collect()
+                    datasets, metrics = handle.collect_tables()
+                land_ms = (time.time() - land_t0) * 1000.0
                 with tracing.span("sinks"):
                     self.dispatcher.dispatch(datasets, batch_time_ms)
                 self.processor.commit()
@@ -292,8 +427,9 @@ class StreamingHost:
                 batch_time_ms, ok=False, error=f"{type(e).__name__}: {e}"
             )
             trace.end(status="error")
-            for s in self.sources.values():
-                s.requeue_unacked()
+            if requeue_on_error:
+                for s in self.sources.values():
+                    s.requeue_unacked()
             logger.exception("batch processing failed; rethrowing for retry")
             raise
 
@@ -301,6 +437,13 @@ class StreamingHost:
         metrics["IngestRateScale"] = self._rate_scale
         metrics["Pipeline_Depth"] = float(inflight_depth)
         metrics["Pipeline_Stall_Ms"] = stall_ms
+        if backlog is not None:
+            # background landing accounting: landings still queued when
+            # this one was submitted (sustained > pipeline depth is the
+            # default backlog alert), and the ms this batch's streamed
+            # tables took to resolve on the landing thread
+            metrics["Transfer_Background_Pending"] = float(backlog)
+            metrics["Transfer_Background_LandMs"] = land_ms
         self.health.record_stall(stall_ms)
         # model-vs-observed conformance: ratio gauges join this batch's
         # metrics; drift transitions become typed flight-recorder events
@@ -479,27 +622,38 @@ class StreamingHost:
 
         Ordering/recovery invariants at every depth:
         - finish/commit is strictly FIFO (the window is a deque popped
-          from the left), so state-table commits, acks and offset
+          from the left, and background landings run on ONE worker in
+          submission order), so state-table commits, acks and offset
           checkpoints happen in dispatch order;
         - each batch joins its source's un-acked FIFO at poll time and
           is acked (in order) only after its own sinks succeed; a
-          failure anywhere requeues EVERY un-acked batch in the window
-          before rethrowing (at-least-once);
+          failure anywhere — including on the landing thread, with
+          background transfers still in flight — drains the landing
+          queue and requeues EVERY un-acked batch in the window before
+          rethrowing (at-least-once);
         - a UDF ``on_interval`` refresh mid-window is safe: every
           ``PendingBatch`` snapshots the pipeline/schemas of the step
           that produced it, so deep windows decode against their own
-          compiled shapes."""
-        from collections import deque
-        from concurrent.futures import ThreadPoolExecutor
+          compiled shapes.
 
+        With ``process.pipeline.backgroundtransfer`` (default on) each
+        finish blocks only on the counts vector; the streamed output
+        tables land and sinks ack on the background landing thread,
+        bounded to at most ``depth`` queued landings (backpressure)."""
         if depth is None:
             depth = self.processor.pipeline_depth
         depth = max(1, depth)
+        background = self.background_transfer and self._landing_pool is not None
         # FIFO window of (PendingBatch, consumed, batch_time_ms, t0, trace)
         pending = deque()
         pool = ThreadPoolExecutor(1)
         fut = None
         fut_trace = None  # the trace of the batch `fut` is decoding
+        # batches started over the host's lifetime: landings may lag
+        # batches_processed, so the loop counts dispatches itself
+        # (previous runs' landings are fully drained at this point)
+        started = self.batches_processed
+        self._landing_failed = None
 
         def drain(f):
             """Wait out an in-flight poll so its delivery lands in the
@@ -515,10 +669,10 @@ class StreamingHost:
 
         try:
             while not self._stop:
-                if (
-                    max_batches is not None
-                    and self.batches_processed + len(pending) >= max_batches
-                ):
+                # a failed background landing surfaces here: stop
+                # feeding the device and run the whole-window requeue
+                self._check_landing_failure()
+                if max_batches is not None and started >= max_batches:
                     break
                 iter_t0 = time.time()
                 self._profiler_tick()
@@ -528,11 +682,10 @@ class StreamingHost:
                 raw, consumed, batch_time_ms, t0 = fut.result()
                 trace, fut, fut_trace = fut_trace, None, None
                 handle = self._dispatch_traced(trace, raw, batch_time_ms)
+                started += 1
                 # decode-ahead: the NEXT batch's poll starts now,
                 # overlapping this window's collects + sinks — but only
-                # if a next iteration will actually run (batches started
-                # so far incl. this one = processed + window + this)
-                started = self.batches_processed + len(pending) + 1
+                # if a next iteration will actually run
                 if not self._stop and (
                     max_batches is None or started < max_batches
                 ):
@@ -543,28 +696,42 @@ class StreamingHost:
                     # window full: retire the oldest batch (strict
                     # FIFO). depth=1 is the legacy single-`pending`
                     # overlap: finish N-1 right after dispatching N.
+                    # In background mode this blocks only on the counts
+                    # vector; the tail lands out-of-band.
                     self._finish(
-                        *pending.popleft(), inflight_depth=len(pending) + 1
+                        *pending.popleft(), inflight_depth=len(pending) + 1,
+                        background=background,
                     )
+                    self._wait_landing_backlog(depth)
                 # backpressure on iteration time, not Latency-Batch: a
                 # pipelined batch's latency spans ~depth iterations by
                 # design
                 self._update_backpressure((time.time() - iter_t0) * 1000.0)
             while pending and not self._stop:
+                self._check_landing_failure()
                 self._finish(
-                    *pending.popleft(), inflight_depth=len(pending) + 1
+                    *pending.popleft(), inflight_depth=len(pending) + 1,
+                    background=background,
                 )
+            # all tails must land before the loop returns (or reports
+            # the failure): collect/sink/ack work is only done when the
+            # landing queue is empty
+            self._drain_landings()
         except Exception:
-            # settle the in-flight poll FIRST, then requeue everything
-            # un-acked across the whole window (covers poll/dispatch
-            # failures; _finish requeues its own failures before
-            # rethrowing, and requeue_unacked is idempotent)
+            # settle the in-flight poll FIRST, then the landing queue
+            # (queued landings after a failure no-op and leave their
+            # batches un-acked), then requeue everything un-acked
+            # across the whole window (covers poll/dispatch failures;
+            # _finish requeues its own failures before rethrowing, and
+            # requeue_unacked is idempotent)
             drain(fut)
             fut = None
             if fut_trace is not None:
                 fut_trace.end(status="aborted")
             for item in pending:
                 item[4].end(status="aborted")  # idempotent
+                item[0].abandon()  # release transfer slots
+            self._settle_landings()
             for s in self.sources.values():
                 s.requeue_unacked()
             raise
@@ -590,6 +757,12 @@ class StreamingHost:
     def stop(self) -> None:
         self._stop = True
         self._stop_profiler()
+        if self._landing_pool is not None:
+            # let queued landings flush their sinks/acks before the
+            # dispatcher and sources close underneath them
+            self._settle_landings()
+            self._landing_pool.shutdown(wait=True)
+            self._landing_pool = None
         if self.obs_server is not None:
             self.obs_server.stop()
             self.obs_server = None

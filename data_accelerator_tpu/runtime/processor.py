@@ -27,6 +27,7 @@ import functools
 import json
 import logging
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple, Union
@@ -67,6 +68,18 @@ DEFAULT_PIPELINE_DEPTH = 2
 TRANSFER_EWMA_ALPHA = 0.25
 TRANSFER_HEADROOM = 4  # sized cap >= HEADROOM * EWMA (burst absorption)
 MIN_TRANSFER_ROWS = 256  # below this, shrinking saves nothing
+# after an overflow re-fetch, the output's headroom factor doubles for
+# the next N batches so back-to-back bursts can't thrash the two-phase
+# fallback (the EWMA jump alone only covers the observed count, not a
+# still-climbing one)
+OVERFLOW_BOOST_FACTOR = 2
+OVERFLOW_BOOST_BATCHES = 8
+
+# donated double-buffered output slots: the jitted slot-pack writes each
+# output's transfer view into one of two resident, transfer-ready buffer
+# sets per (output, capacity bucket), alternating A/B so batch N+1's
+# step never clobbers batch N's in-flight background D2H copy
+OUTPUT_SLOT_BUFFERS = 2
 
 _CTYPE_TO_PLAN = {
     ColType.LONG: "long",
@@ -375,6 +388,24 @@ class FlowProcessor:
         self.transfer_ewma: Dict[str, float] = {}
         # counters drained into Transfer_<name>_Count metrics at collect
         self.transfer_stats: Dict[str, int] = {}
+        # outputs still riding the post-overflow doubled headroom:
+        # name -> batches remaining
+        self.transfer_boost: Dict[str, int] = {}
+        # donated double-buffered output slots (off under a mesh, whose
+        # sharded outputs can't alias a single-device buffer):
+        # (output, capacity) -> [slot A, slot B], each slot the
+        # (TableData, landed-event of the batch that last shipped it)
+        self.output_slots_enabled = (
+            (pipe_conf.get_or_else("outputslots", "true") or "").lower()
+            != "false"
+        ) and mesh is None
+        self._slots: Dict[Tuple[str, int], list] = {}
+        self._slot_parity: Dict[str, int] = {}
+        # serializes ring/state donation in dispatch against the
+        # window-state snapshot a background landing thread may take at
+        # checkpoint time (snapshotting a ring the next dispatch has
+        # already donated would read a deleted buffer)
+        self._device_state_lock = threading.Lock()
 
         self.interval_s = float(
             input_conf.get_or_else("streaming.intervalinseconds", "1")
@@ -742,18 +773,22 @@ class FlowProcessor:
         the dictionary that encoded them. Numpy-only; feed to
         ``WindowStateCheckpointer.save`` (reference restores window state
         via the StreamingContext checkpoint, StreamingHost.scala:83-89)."""
-        rings = {}
-        for table, buf in self.window_buffers.items():
-            rings[table] = {
-                "cols": {c: np.asarray(a) for c, a in buf.cols.items()},
-                "valid": np.asarray(buf.valid),
+        # under the device-state lock: the checkpoint may run on the
+        # background landing thread while the dispatch thread is about
+        # to donate these very ring buffers into the next step
+        with self._device_state_lock:
+            rings = {}
+            for table, buf in self.window_buffers.items():
+                rings[table] = {
+                    "cols": {c: np.asarray(a) for c, a in buf.cols.items()},
+                    "valid": np.asarray(buf.valid),
+                }
+            return {
+                "rings": rings,
+                "slot_counter": self._slot_counter,
+                "base_ms": self._base_ms,
+                "dictionary": self.dictionary.entries(),
             }
-        return {
-            "rings": rings,
-            "slot_counter": self._slot_counter,
-            "base_ms": self._base_ms,
-            "dictionary": self.dictionary.entries(),
-        }
 
     def restore_window_state(self, snap: Dict[str, object]) -> bool:
         """Restore a ``snapshot_window_state`` result. Shape-checked: a
@@ -1208,30 +1243,34 @@ class FlowProcessor:
         aux = self.aux_tables.tables()
         # child span of the host's "dispatch" when a batch trace is
         # active (obs/tracing.py); a no-op under bench/LiveQuery drivers
-        with _trace_span("device-enqueue"), self._debug_guard():
+        with _trace_span("device-enqueue"), self._debug_guard(), \
+                self._device_state_lock:
             out_datasets, new_rings, new_state, counts_vec = self._step(
                 raw, self.window_buffers, self.state_data, refdata_tables,
                 base_s, now_rel_ms, counter, jnp.asarray(delta_ms, jnp.int32),
                 aux,
             )
-        # carry device state forward without materializing — the next
-        # dispatch may consume these handles before this batch collects
-        self.window_buffers = new_rings
-        self.state_data = new_state
+            # carry device state forward without materializing — the next
+            # dispatch may consume these handles before this batch collects
+            self.window_buffers = new_rings
+            self.state_data = new_state
         # sized output transfer: shrink each output's D2H copy to its
-        # adaptive capacity (power-of-two bucket over the count EWMA).
+        # adaptive capacity (power-of-two bucket over the count EWMA),
+        # written into the output's donated A/B transfer slot so the
+        # buffers the background copies stream from stay resident.
         # The device has already compacted valid rows to the front, so
         # the slice keeps every real row as long as the cap holds; the
         # full-capacity table stays referenced for the two-phase
         # overflow fallback in collect().
-        fetch_tables: Dict[str, TableData] = dict(out_datasets)
+        fetch_tables: Dict[str, TableData] = {}
         fetch_caps: Dict[str, int] = {}
+        staged_slots = []  # (slot key, parity) filled below the handle
         for n, t in out_datasets.items():
             full_cap = int(t.valid.shape[0])
             cap = self.transfer_capacity(n, full_cap)
             fetch_caps[n] = cap
-            if cap < full_cap:
-                fetch_tables[n] = _slice_table(t, cap)
+            fetch_tables[n] = self._stage_output(n, t, cap, full_cap,
+                                                 staged_slots)
         handle = PendingBatch(
             self, self.pipeline, out_datasets, new_state, counts_vec,
             batch_time_ms, new_base_ms, t0,
@@ -1240,6 +1279,12 @@ class FlowProcessor:
             fetch_tables=fetch_tables,
             fetch_caps=fetch_caps,
         )
+        # each staged slot is owned by THIS batch until its transfer
+        # lands: record the handle's landed-event so the dispatch that
+        # next rotates onto the slot knows whether donation is safe
+        for key, parity in staged_slots:
+            table, _ev = self._slots[key][parity]
+            self._slots[key][parity] = (table, handle._landed)
         # begin the device->host result copies NOW (async enqueue, free):
         # by the time collect() runs — typically one pipelined iteration
         # later — the data has already crossed the boundary, so collect
@@ -1247,6 +1292,45 @@ class FlowProcessor:
         # round trip is a network RTT, the single largest per-batch cost.
         handle.start_fetch()
         return handle
+
+    def _stage_output(
+        self, name: str, t: TableData, cap: int, full_cap: int,
+        staged_slots: list,
+    ) -> TableData:
+        """Build output ``name``'s transfer view at capacity ``cap``.
+
+        With output slots enabled the view is written into one of the
+        output's two resident transfer slots (A/B rotation): the slot
+        buffer is DONATED into the jitted pack, so XLA writes the sliced
+        rows straight into the transfer-ready memory the background D2H
+        copy will stream from — batch N+1 packs into the other slot, so
+        an in-flight transfer of batch N is never clobbered. A slot
+        whose previous transfer has not landed yet (deep backlog, or an
+        abandoned handle) falls back to a fresh buffer instead of
+        blocking the dispatch loop — correctness first, reuse when safe.
+        """
+        if not self.output_slots_enabled or not all(
+            v.shape[:1] == t.valid.shape for v in t.cols.values()
+        ):
+            return _slice_table(t, cap) if cap < full_cap else t
+        key = (name, cap)
+        ring = self._slots.setdefault(key, [None] * OUTPUT_SLOT_BUFFERS)
+        parity = self._slot_parity.get(name, 0) % OUTPUT_SLOT_BUFFERS
+        self._slot_parity[name] = parity + 1
+        prev = ring[parity]
+        if prev is not None and prev[1].is_set():
+            # the batch that last shipped this slot has landed its host
+            # copy: donate the buffers back into the pack
+            staged = _pack_slot(t, prev[0], cap)
+        else:
+            # first use of this (output, cap) slot, or its transfer is
+            # still in flight: allocate fresh transfer buffers
+            if prev is not None:
+                self._bump_transfer_stat("SlotContended")
+            staged = _slice_table(t, cap)
+        ring[parity] = (staged, _SET_EVENT)
+        staged_slots.append((key, parity))
+        return staged
 
     def process_batch(
         self,
@@ -1264,16 +1348,21 @@ class FlowProcessor:
     def transfer_capacity(self, name: str, full_cap: int) -> int:
         """Adaptive D2H transfer capacity for output ``name``: the EWMA
         of observed valid counts with ``TRANSFER_HEADROOM`` x burst
-        margin, bucketed to a power of two. Engages only once counts
-        have been observed and only when it at least halves the copy
-        (otherwise the full fetch is simpler and no slower)."""
+        margin (doubled for ``OVERFLOW_BOOST_BATCHES`` batches after an
+        overflow re-fetch), bucketed to a power of two. Engages only
+        once counts have been observed and only when it at least halves
+        the copy (otherwise the full fetch is simpler and no slower)."""
         if not self.sized_transfer:
             return full_cap
         ewma = self.transfer_ewma.get(name)
         if ewma is None:
             return full_cap
+        headroom = TRANSFER_HEADROOM * (
+            OVERFLOW_BOOST_FACTOR if self.transfer_boost.get(name, 0) > 0
+            else 1
+        )
         cap = _pow2_ceil(
-            max(int(ewma * TRANSFER_HEADROOM) + 1, MIN_TRANSFER_ROWS)
+            max(int(ewma * headroom) + 1, MIN_TRANSFER_ROWS)
         )
         return cap if cap * 2 <= full_cap else full_cap
 
@@ -1281,13 +1370,17 @@ class FlowProcessor:
         """Feed observed per-output valid counts into the EWMA (called
         from ``PendingBatch.collect``; an overflow re-fetch also bumps
         the EWMA straight to the observed count so the very next batch
-        sizes correctly)."""
+        sizes correctly). Each observation also burns one batch off any
+        post-overflow headroom boost."""
         a = TRANSFER_EWMA_ALPHA
         for n, c in counts.items():
             prev = self.transfer_ewma.get(n)
             self.transfer_ewma[n] = (
                 float(c) if prev is None else a * c + (1.0 - a) * prev
             )
+            boost = self.transfer_boost.get(n, 0)
+            if boost > 0:
+                self.transfer_boost[n] = boost - 1
 
     def _bump_transfer_stat(self, key: str) -> None:
         self.transfer_stats[key] = self.transfer_stats.get(key, 0) + 1
@@ -1341,6 +1434,29 @@ def _pow2_ceil(n: int) -> int:
     return 1 << max(int(n) - 1, 0).bit_length()
 
 
+# placeholder for "no transfer in flight" while a freshly staged slot
+# waits for its owning PendingBatch to be constructed
+_SET_EVENT = threading.Event()
+_SET_EVENT.set()
+
+
+@functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
+def _pack_slot(t: TableData, slot: TableData, cap: int) -> TableData:
+    """Device-side pack of an (already compacted) output table into its
+    donated transfer slot: identical math to ``_slice_table``, but the
+    ``slot`` argument's buffers are DONATED, so XLA writes the result
+    into the resident transfer-ready memory instead of allocating — the
+    background D2H stream then always reads from one of two stable
+    buffer sets per output. The caller guarantees the donated slot's
+    previous transfer has landed (PendingBatch._landed)."""
+    del slot  # consumed via donation: provides the output buffers
+    return TableData(
+        {c: v[:cap] if v.shape[:1] == t.valid.shape else v
+         for c, v in t.cols.items()},
+        t.valid[:cap],
+    )
+
+
 @functools.partial(jax.jit, static_argnums=(1,))
 def _slice_table(t: TableData, cap: int) -> TableData:
     """Device-side shrink of an (already compacted) output table to its
@@ -1358,26 +1474,31 @@ def _slice_table(t: TableData, cap: int) -> TableData:
     )
 
 
-# does this backend's Array support copy_to_host_async? Probed ONCE per
-# process (the satellite fix for the old blanket try/except in
-# start_fetch, which also swallowed *real* transfer errors): capability
-# misses are cached and counted as a metric; after a successful probe,
-# transfer failures propagate to the batch loop like any other error.
-_ASYNC_COPY_SUPPORT: Optional[bool] = None
+# does this array type support copy_to_host_async? Probed ONCE per
+# *backend array type* (the old probe ran once per process on the
+# counts vector and assumed the answer for table arrays — a mixed
+# backend, or a committed/donated array class with different transfer
+# semantics, silently took the wrong path): capability misses are
+# cached per type and counted per TABLE in
+# Transfer_AsyncCopyFallback_Count; after a successful probe, transfer
+# failures propagate to the batch loop like any other error.
+_ASYNC_COPY_SUPPORT: Dict[type, bool] = {}
 
 
 def _async_copy_supported(arr) -> bool:
-    global _ASYNC_COPY_SUPPORT
-    if _ASYNC_COPY_SUPPORT is None:
+    t = type(arr)
+    cached = _ASYNC_COPY_SUPPORT.get(t)
+    if cached is None:
         if not hasattr(arr, "copy_to_host_async"):
-            _ASYNC_COPY_SUPPORT = False
+            cached = False
         else:
             try:
                 arr.copy_to_host_async()  # idempotent enqueue
-                _ASYNC_COPY_SUPPORT = True
+                cached = True
             except (AttributeError, NotImplementedError, TypeError):
-                _ASYNC_COPY_SUPPORT = False
-    return _ASYNC_COPY_SUPPORT
+                cached = False
+        _ASYNC_COPY_SUPPORT[t] = cached
+    return cached
 
 
 def _host_table_nbytes(t: TableData) -> int:
@@ -1390,9 +1511,36 @@ def _host_table_nbytes(t: TableData) -> int:
 SMALL_FETCH_ROWS = 16384
 
 
+@dataclass
+class BatchCounts:
+    """The parsed counts vector — everything the cheap blocking sync
+    (``collect_counts``) learns about a batch: per-output valid row
+    counts, the dropped-group/join overflow slots, and per-source
+    projected input counts. A few hundred bytes on the wire; the output
+    tables themselves stream in the background and resolve later via
+    ``collect_tables``."""
+
+    counts: np.ndarray  # the raw packed vector (nbytes = sync cost)
+    dataset_counts: Dict[str, int]
+    dropped_groups: Dict[str, int]
+    dropped_joins: Dict[str, int]
+    target_counts: Dict[str, int]
+
+
 class PendingBatch:
     """An in-flight micro-batch: device work queued, results not yet
-    fetched. ``collect()`` performs the (single) host sync."""
+    fetched.
+
+    Two-phase result path (the device-resident tail): the packed
+    ``counts_vec`` and the (sized, slot-staged) output tables all start
+    streaming device->host at dispatch; ``collect_counts()`` is the only
+    BLOCKING device read — it resolves the counts vector (a few hundred
+    bytes) and is the batch's sync point. ``collect_tables()`` then
+    resolves the already-streaming table copies, materializes rows and
+    persists state — typically on a background landing thread, so sinks
+    ack out-of-band while the dispatch loop keeps feeding the device.
+    ``collect()`` = counts + tables, the synchronous back-compat path
+    (byte-identical results, golden-tested)."""
 
     def __init__(
         self, proc: "FlowProcessor", pipeline, out_datasets, state,
@@ -1435,6 +1583,19 @@ class PendingBatch:
         # D2H accounting for this batch (Transfer_* metrics)
         self._d2h_bytes = 0
         self._transferred_rows = 0
+        # parsed counts vector, cached by collect_counts (the sync
+        # point happens at most once per batch)
+        self._counts: Optional[BatchCounts] = None
+        # set once the host copies of the fetch tables have landed (or
+        # the batch is abandoned): the signal slot rotation checks
+        # before donating this batch's transfer buffers to a new pack
+        self._landed = threading.Event()
+
+    def abandon(self) -> None:
+        """Mark a batch that will never be collected (window requeued
+        after a failure): releases its transfer slots for donation and
+        unblocks anyone coordinating on the landing."""
+        self._landed.set()
 
     def start_fetch(self) -> None:
         """Enqueue async device->host copies of everything collect()
@@ -1447,19 +1608,27 @@ class PendingBatch:
         table.
 
         Backend capability (``copy_to_host_async``) is probed once per
-        process; an unsupported backend falls back to the synchronous
-        fetch in collect() and is counted in
+        backend ARRAY TYPE (counts vector and table arrays can differ —
+        e.g. a donated slot class); an unsupported type falls back to
+        the synchronous fetch in collect() and is counted PER TABLE in
         ``Transfer_AsyncCopyFallback_Count``. Real transfer errors are
         NOT swallowed — they propagate to the batch loop for retry."""
         if not _async_copy_supported(self.counts_vec):
             self.proc._bump_transfer_stat("AsyncCopyFallback")
             return
         self.counts_vec.copy_to_host_async()
+        prefetched_all = True
         for t in self.fetch_tables.values():
-            for a in t.cols.values():
+            arrays = list(t.cols.values()) + [t.valid]
+            if not all(_async_copy_supported(a) for a in arrays):
+                # this table's array type can't stream: one fallback
+                # count per table, not one blanket flag per batch
+                self.proc._bump_transfer_stat("AsyncCopyFallback")
+                prefetched_all = False
+                continue
+            for a in arrays:
                 a.copy_to_host_async()
-            t.valid.copy_to_host_async()
-        self._prefetched = True
+        self._prefetched = prefetched_all
 
     def block_until_evaluated(self) -> None:
         """Wait for the device step to COMPLETE (rule evaluation done,
@@ -1467,108 +1636,143 @@ class PendingBatch:
         'rules evaluated' timestamp, independent of result transport."""
         jax.block_until_ready(self.counts_vec)
 
-    def collect(self) -> Tuple[Dict[str, List[dict]], Dict[str, float]]:
-        """Sync, transfer, materialize; returns (datasets, metrics).
-
-        With a prior ``start_fetch()`` (the default from
-        ``dispatch_batch``) every read below hits an already-landed host
-        copy. Otherwise: ONE host sync for every per-batch scalar
-        (layout: input count, per-output counts, per-output overflow
-        slots for groups then joins, per-source projected counts), then
-        the device-compacted outputs are sliced to their true row counts
-        so only real rows cross the device->host boundary, fetched in
-        one batched device_get.
-        """
-        proc = self.proc
-        with _trace_span("device-fetch"):
-            if self._prefetched or proc.batch_capacity <= SMALL_FETCH_ROWS:
-                # sized-table transfer in ONE round trip (counts + sized
-                # outputs together) — prefetched at dispatch, or small
-                # enough that the extra bytes cost less than a second
-                # host<->device sync
-                counts, host_full = jax.device_get(
-                    (self.counts_vec, self.fetch_tables)
-                )
-            else:
-                counts = np.asarray(self.counts_vec)
-                host_full = None
+    def collect_counts(self) -> BatchCounts:
+        """The batch's ONLY blocking device read: resolve the packed
+        counts vector (layout: input count, per-output counts,
+        per-output overflow slots for groups then joins, per-source
+        projected counts — a few hundred bytes, already streaming since
+        dispatch) and parse it. Idempotent; the sync point is paid at
+        most once per batch."""
+        if self._counts is not None:
+            return self._counts
+        with _trace_span("sync-counts"):
+            counts = np.asarray(self.counts_vec)
         # unpack in PACKING order (snapshotted at dispatch) — jax returns
         # dict pytrees with sorted keys, so iterating out_datasets may
         # not match the order the step packed counts in
         names = self.out_names
         tnames = self.target_names
-        dataset_counts = {
-            n: int(counts[1 + i]) for i, n in enumerate(names)
-        }
-        dropped_groups = {
-            n: int(counts[1 + len(names) + i])
-            for i, n in enumerate(names)
-            if int(counts[1 + len(names) + i]) >= 0
-        }
-        dropped_joins = {
-            n: int(counts[1 + 2 * len(names) + i])
-            for i, n in enumerate(names)
-            if int(counts[1 + 2 * len(names) + i]) >= 0
-        }
-        target_counts = {
-            t: int(counts[1 + 3 * len(names) + i])
-            for i, t in enumerate(tnames)
-        }
-        if host_full is not None:
-            self._d2h_bytes = counts.nbytes + sum(
-                _host_table_nbytes(t) for t in host_full.values()
-            )
-            self._transferred_rows = sum(
-                int(t.valid.shape[0]) for t in host_full.values()
-            )
-            host_tables: Dict[str, TableData] = {}
-            for n, t in host_full.items():
-                cnt = dataset_counts[n]
-                if cnt > int(t.valid.shape[0]):
-                    # two-phase fallback: the sized prefetch undershot
-                    # (count exceeds the adaptive capacity) — re-fetch
-                    # the full-capacity table sliced to the true count.
-                    # Rare by construction (EWMA + headroom + pow2
-                    # bucket), loud in Transfer_Overflow_Count.
-                    proc._bump_transfer_stat("Overflow")
-                    # jump the EWMA straight to the observed count so
-                    # the very next batch sizes above it
-                    proc.transfer_ewma[n] = float(cnt)
-                    full = self.out_datasets[n]
-                    with _trace_span("device-refetch"):
-                        t = jax.device_get(TableData(
-                            {c: v[:cnt]
-                             if v.shape[:1] == full.valid.shape else v
-                             for c, v in full.cols.items()},
-                            full.valid[:cnt],
-                        ))
-                    self._d2h_bytes += _host_table_nbytes(t)
-                    self._transferred_rows += cnt
-                    host_tables[n] = t
+        self._counts = BatchCounts(
+            counts=counts,
+            dataset_counts={
+                n: int(counts[1 + i]) for i, n in enumerate(names)
+            },
+            dropped_groups={
+                n: int(counts[1 + len(names) + i])
+                for i, n in enumerate(names)
+                if int(counts[1 + len(names) + i]) >= 0
+            },
+            dropped_joins={
+                n: int(counts[1 + 2 * len(names) + i])
+                for i, n in enumerate(names)
+                if int(counts[1 + 2 * len(names) + i]) >= 0
+            },
+            target_counts={
+                t: int(counts[1 + 3 * len(names) + i])
+                for i, t in enumerate(tnames)
+            },
+        )
+        return self._counts
+
+    def collect(self) -> Tuple[Dict[str, List[dict]], Dict[str, float]]:
+        """Synchronous back-compat result path: counts sync + table
+        landing in one call. Byte-identical to the split
+        ``collect_counts()`` / ``collect_tables()`` background path
+        (golden-tested in tests/test_sized_transfer.py)."""
+        return self.collect_tables()
+
+    def collect_tables(self) -> Tuple[Dict[str, List[dict]], Dict[str, float]]:
+        """Resolve the background-streamed output tables, materialize
+        rows and persist state; returns (datasets, metrics).
+
+        With a prior ``start_fetch()`` (the default from
+        ``dispatch_batch``) every device read below hits an
+        already-landed host copy — this is the landing half the
+        streaming host runs on its background transfer thread.
+        Otherwise the device-compacted outputs are sliced to the true
+        row counts ``collect_counts`` learned, so only real rows cross
+        the device->host boundary, fetched in one batched device_get.
+        """
+        proc = self.proc
+        bc = self.collect_counts()
+        counts = bc.counts
+        dataset_counts = bc.dataset_counts
+        dropped_groups = bc.dropped_groups
+        dropped_joins = bc.dropped_joins
+        target_counts = bc.target_counts
+        names = self.out_names
+        try:
+            with _trace_span("device-fetch"):
+                if self._prefetched or proc.batch_capacity <= SMALL_FETCH_ROWS:
+                    # sized/slot-staged tables, already streaming since
+                    # dispatch — prefetched, or small enough that the
+                    # extra bytes cost less than a second device slice
+                    host_full = jax.device_get(self.fetch_tables)
                 else:
-                    host_tables[n] = TableData(
-                        {c: v[:cnt] if v.shape[:1] == t.valid.shape else v
-                         for c, v in t.cols.items()},
-                        t.valid[:cnt],
-                    )
-        else:
-            # counts-first path (large batch, no prefetch): slice on
-            # device to the exact counts, then one batched device_get —
-            # already the wire minimum, sized transfer adds nothing
-            sliced = {
-                n: TableData(
-                    {c: v[: dataset_counts[n]]
-                     if v.shape[:1] == t.valid.shape else v
-                     for c, v in t.cols.items()},
-                    t.valid[: dataset_counts[n]],
+                    host_full = None
+            if host_full is not None:
+                self._d2h_bytes = counts.nbytes + sum(
+                    _host_table_nbytes(t) for t in host_full.values()
                 )
-                for n, t in self.out_datasets.items()
-            }
-            host_tables = jax.device_get(sliced)
-            self._d2h_bytes = counts.nbytes + sum(
-                _host_table_nbytes(t) for t in host_tables.values()
-            )
-            self._transferred_rows = sum(dataset_counts.values())
+                self._transferred_rows = sum(
+                    int(t.valid.shape[0]) for t in host_full.values()
+                )
+                host_tables: Dict[str, TableData] = {}
+                for n, t in host_full.items():
+                    cnt = dataset_counts[n]
+                    if cnt > int(t.valid.shape[0]):
+                        # two-phase fallback: the sized prefetch undershot
+                        # (count exceeds the adaptive capacity) — re-fetch
+                        # the full-capacity table sliced to the true count.
+                        # Rare by construction (EWMA + headroom + pow2
+                        # bucket), loud in Transfer_Overflow_Count.
+                        proc._bump_transfer_stat("Overflow")
+                        # jump the EWMA straight to the observed count so
+                        # the very next batch sizes above it, and double
+                        # the headroom factor for the next
+                        # OVERFLOW_BOOST_BATCHES batches so back-to-back
+                        # bursts can't thrash the two-phase fetch
+                        proc.transfer_ewma[n] = float(cnt)
+                        proc.transfer_boost[n] = OVERFLOW_BOOST_BATCHES
+                        full = self.out_datasets[n]
+                        with _trace_span("device-refetch"):
+                            t = jax.device_get(TableData(
+                                {c: v[:cnt]
+                                 if v.shape[:1] == full.valid.shape else v
+                                 for c, v in full.cols.items()},
+                                full.valid[:cnt],
+                            ))
+                        self._d2h_bytes += _host_table_nbytes(t)
+                        self._transferred_rows += cnt
+                        host_tables[n] = t
+                    else:
+                        host_tables[n] = TableData(
+                            {c: v[:cnt] if v.shape[:1] == t.valid.shape else v
+                             for c, v in t.cols.items()},
+                            t.valid[:cnt],
+                        )
+            else:
+                # counts-first path (large batch, no prefetch): slice on
+                # device to the exact counts, then one batched device_get —
+                # already the wire minimum, sized transfer adds nothing
+                sliced = {
+                    n: TableData(
+                        {c: v[: dataset_counts[n]]
+                         if v.shape[:1] == t.valid.shape else v
+                         for c, v in t.cols.items()},
+                        t.valid[: dataset_counts[n]],
+                    )
+                    for n, t in self.out_datasets.items()
+                }
+                host_tables = jax.device_get(sliced)
+                self._d2h_bytes = counts.nbytes + sum(
+                    _host_table_nbytes(t) for t in host_tables.values()
+                )
+                self._transferred_rows = sum(dataset_counts.values())
+        finally:
+            # host copies landed (or the fetch failed): this batch's
+            # transfer slots are safe to donate to a future pack
+            self._landed.set()
 
         datasets: Dict[str, List[dict]] = {}
         with _trace_span("materialize"):
@@ -1637,6 +1841,10 @@ class PendingBatch:
                 valid_rows / self._transferred_rows
                 if self._transferred_rows else 1.0
             )
+        # bytes the blocking counts-only sync moved — the whole
+        # synchronous wire cost of the batch tail (everything else
+        # streams in the background)
+        metrics["Sync_CountsBytes"] = float(counts.nbytes)
         if proc.transfer_stats:
             for k, v in proc.transfer_stats.items():
                 metrics[f"Transfer_{k}_Count"] = float(v)

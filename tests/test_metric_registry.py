@@ -165,6 +165,23 @@ def test_conformance_and_alert_metrics_are_registered():
     assert not MetricName.is_runtime_metric("Alerts_Bogus")
 
 
+def test_background_transfer_metrics_are_registered():
+    """The device-resident result path's series (runtime/processor.py
+    collect_counts/collect_tables + runtime/host.py background landing)
+    resolve through the registry: the counts-only sync's wire bytes,
+    the landing backlog/latency gauges, and the slot-contention
+    counter."""
+    for m in (
+        "Sync_CountsBytes",
+        "Transfer_Background_Pending",
+        "Transfer_Background_LandMs",
+        "Transfer_SlotContended_Count",
+    ):
+        assert MetricName.is_runtime_metric(m), m
+    assert not MetricName.is_runtime_metric("Transfer_Background_Bogus")
+    assert not MetricName.is_runtime_metric("Sync_Bogus")
+
+
 def test_default_alert_rules_validate_and_resolve_for_shipped_flows():
     """CI satellite: the default-generated alert rules are
     schema-valid, and every threshold rule's series name resolves

@@ -146,15 +146,26 @@ DEPTH_SCHEMA = json.dumps({"type": "struct", "fields": [
 
 class _RecordingSink:
     """Records successful writes in arrival order; raises (BEFORE
-    recording) on any batch containing a poisoned k value while armed."""
+    recording) on any batch containing a poisoned k value while armed.
+    Also records which thread each write ran on (the background landing
+    path runs sinks on the dedicated landing worker) and optionally
+    sleeps first so landings genuinely queue behind the dispatch
+    loop."""
 
     kind = "recording"
 
     def __init__(self):
         self.batches = []  # (batch_time_ms, [k...]) per successful write
         self.poison_k = None
+        self.threads = []  # thread name per write attempt
+        self.delay_s = 0.0
 
     def write(self, dataset, rows, batch_time_ms):
+        import threading
+
+        self.threads.append(threading.current_thread().name)
+        if self.delay_s:
+            _time.sleep(self.delay_s)
         ks = [r["k"] for r in rows]
         if self.poison_k is not None and self.poison_k in ks:
             raise RuntimeError(f"poisoned batch (k={self.poison_k})")
@@ -287,6 +298,68 @@ def test_depth_window_dispatch_failure_requeues_window(tmp_path, depth):
 
         host.processor.dispatch_batch = real_dispatch
         host.run_pipelined(max_batches=4)
+        assert host.batches_processed == 4
+        all_ks = [k for _t, ks in sink.batches for k in ks]
+        assert all_ks == list(range(16))
+    finally:
+        host.stop()
+
+
+@pytest.mark.parametrize("depth", [1, 2, 4])
+def test_background_landing_failure_drains_and_requeues(tmp_path, depth):
+    """Tentpole failure injection: sinks run on the BACKGROUND landing
+    thread (counts-only sync on the dispatch loop) and the sink throws
+    while later batches' transfers are in flight. The whole un-acked
+    window requeues, pending landings are drained (not left queued),
+    FIFO commit order holds, and a healed rerun delivers every event
+    exactly once."""
+    import threading
+
+    host, src, sink = _depth_host(tmp_path, depth)
+    try:
+        assert host.background_transfer  # default on
+        # spy on the batch tail so the test can prove it ran on the
+        # background landing worker, not the dispatch loop
+        tail_threads = []
+        orig_tail = host._finish_tail
+
+        def spy_tail(*a, **kw):
+            tail_threads.append(threading.current_thread().name)
+            return orig_tail(*a, **kw)
+
+        host._finish_tail = spy_tail
+        sink.delay_s = 0.05  # landings queue while the loop dispatches
+        _feed_socket(src, 16)  # batches B1(k 0-3) .. B4(k 12-15)
+        sink.poison_k = 9  # B3's landing fails at the sink
+        with pytest.raises(RuntimeError, match="poisoned"):
+            host.run_pipelined(max_batches=4)
+        # batch tails genuinely ran out-of-band on the landing worker
+        assert tail_threads and all(
+            t.startswith("landing") for t in tail_threads
+        )
+        # the landing queue was drained before the requeue — nothing
+        # still in flight to ack a requeued batch behind our back
+        assert len(host._landings) == 0
+        assert host._landing_failed is not None
+        # FIFO: exactly B1 and B2 committed, in dispatch order
+        assert [ks for _t, ks in sink.batches] == [
+            [0, 1, 2, 3], [4, 5, 6, 7],
+        ]
+        assert host.batches_processed == 2
+        # every un-acked batch in the window re-delivers in order
+        redelivered = []
+        for _ in range(2):
+            blob, n, _ = src.poll_raw(4)
+            assert n == 4
+            redelivered.extend(_delivered_ks(blob))
+        assert redelivered == list(range(8, 16))
+        src.requeue_unacked()
+
+        # healed rerun: exactly-once delivery, failure flag re-armed
+        sink.poison_k = None
+        sink.delay_s = 0.0
+        host.run_pipelined(max_batches=4)
+        assert host._landing_failed is None
         assert host.batches_processed == 4
         all_ks = [k for _t, ks in sink.batches for k in ks]
         assert all_ks == list(range(16))

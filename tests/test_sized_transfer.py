@@ -1,19 +1,28 @@
-"""Sized output transfer: the D2H copy tracks observed row counts.
+"""Sized output transfer + the device-resident result path.
 
-Covers the tentpole's transfer half: the EWMA-driven power-of-two
-capacity, the golden overflow guarantee (a batch whose count exceeds
-the adaptive capacity returns EXACTLY the rows a full-capacity fetch
-returns, via the two-phase counts_vec-detected re-fetch), the
-once-per-backend ``copy_to_host_async`` capability probe, and the
-Transfer_* metric surface."""
+Covers the transfer half of both tentpoles: the EWMA-driven
+power-of-two capacity, the golden overflow guarantee (a batch whose
+count exceeds the adaptive capacity returns EXACTLY the rows a
+full-capacity fetch returns, via the two-phase counts_vec-detected
+re-fetch) plus the post-overflow headroom boost, the per-array-type
+``copy_to_host_async`` capability probe with per-table fallback
+counting, the split ``collect_counts()``/``collect_tables()`` result
+path (golden-equal to the synchronous ``collect()``, including with the
+landing on a background thread and the donated A/B output slots
+rotating), and the Transfer_*/Sync_* metric surface."""
 
 import json
+from concurrent.futures import ThreadPoolExecutor
 
 import pytest
 
 from data_accelerator_tpu.core.config import EngineException, SettingDictionary
 from data_accelerator_tpu.runtime import processor as processor_mod
-from data_accelerator_tpu.runtime.processor import FlowProcessor
+from data_accelerator_tpu.runtime.processor import (
+    OUTPUT_SLOT_BUFFERS,
+    OVERFLOW_BOOST_BATCHES,
+    FlowProcessor,
+)
 
 SCHEMA = json.dumps({"type": "struct", "fields": [
     {"name": "k", "type": "long", "nullable": False, "metadata": {}},
@@ -23,6 +32,13 @@ SCHEMA = json.dumps({"type": "struct", "fields": [
 TRANSFORM = (
     "--DataXQuery--\n"
     "Out = SELECT k, v FROM DataXProcessedInput\n"
+)
+
+TWO_OUT_TRANSFORM = (
+    "--DataXQuery--\n"
+    "Out = SELECT k, v FROM DataXProcessedInput\n"
+    "--DataXQuery--\n"
+    "Out2 = SELECT k FROM DataXProcessedInput\n"
 )
 
 
@@ -88,17 +104,56 @@ def test_overflow_refetch_matches_full_capacity_fetch(tmp_path):
     assert "Transfer_Overflow_Count" not in m2
 
 
-def test_async_copy_capability_probed_once_and_counted(tmp_path, monkeypatch):
-    """An unsupported backend (no copy_to_host_async) falls back to the
-    synchronous fetch — counted per batch in
-    Transfer_AsyncCopyFallback_Count, results identical."""
-    monkeypatch.setattr(processor_mod, "_ASYNC_COPY_SUPPORT", False)
+def test_async_copy_capability_probed_per_type_and_counted(
+    tmp_path, monkeypatch
+):
+    """An unsupported backend array type (no copy_to_host_async) falls
+    back to the synchronous fetch — the capability is cached per ARRAY
+    TYPE and counted in Transfer_AsyncCopyFallback_Count, results
+    identical."""
+    import jax.numpy as jnp
+
+    arr_type = type(jnp.zeros((1,), jnp.int32))
+    monkeypatch.setattr(
+        processor_mod, "_ASYNC_COPY_SUPPORT", {arr_type: False}
+    )
     proc = _proc(tmp_path)
     h = proc.dispatch_batch(proc.encode_rows(_rows(5), 0), 1000)
     assert not h._prefetched
     datasets, metrics = h.collect()
     assert len(datasets["Out"]) == 5
     assert metrics["Transfer_AsyncCopyFallback_Count"] == 1.0
+    # the probe result stayed cached for the type (no flip-flop)
+    assert processor_mod._ASYNC_COPY_SUPPORT[arr_type] is False
+
+
+def test_async_copy_fallback_counted_per_table(tmp_path, monkeypatch):
+    """When the counts vector streams but table arrays can't, each
+    affected TABLE counts one fallback (the old probe flagged once per
+    batch and assumed the counts probe covered table arrays too)."""
+    # counts_vec is a tiny vector; output table columns are >= 256 rows
+    monkeypatch.setattr(
+        processor_mod, "_async_copy_supported", lambda a: a.size <= 16
+    )
+    # a two-output transform so per-table counting shows
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    t = tmp_path / "two.transform"
+    t.write_text(TWO_OUT_TRANSFORM)
+    d = {
+        "datax.job.name": "SizedFlow2",
+        "datax.job.input.default.blobschemafile": SCHEMA,
+        "datax.job.process.transform": str(t),
+        "datax.job.process.batchcapacity": "4096",
+    }
+    proc = FlowProcessor(
+        SettingDictionary(d), output_datasets=["Out", "Out2"]
+    )
+    h = proc.dispatch_batch(proc.encode_rows(_rows(5), 0), 1000)
+    assert not h._prefetched  # no table landed ahead of time
+    datasets, metrics = h.collect()
+    assert len(datasets["Out"]) == 5
+    assert len(datasets["Out2"]) == 5
+    assert metrics["Transfer_AsyncCopyFallback_Count"] == 2.0  # per table
 
 
 def test_pipeline_depth_conf_validation(tmp_path):
@@ -106,3 +161,124 @@ def test_pipeline_depth_conf_validation(tmp_path):
         _proc(tmp_path, {"datax.job.process.pipeline.depth": "0"})
     proc = _proc(tmp_path / "ok", {"datax.job.process.pipeline.depth": "4"})
     assert proc.pipeline_depth == 4
+
+
+# ---------------------------------------------------------------------------
+# device-resident result path: counts-only sync + background landing
+# ---------------------------------------------------------------------------
+def test_overflow_boosts_headroom_for_following_batches(tmp_path):
+    """Satellite: an overflow re-fetch doubles the output's headroom
+    factor for the next OVERFLOW_BOOST_BATCHES batches (on top of the
+    EWMA jump), so back-to-back growing bursts can't thrash the
+    two-phase fetch; the boost then expires."""
+    proc = _proc(tmp_path)
+    proc.transfer_ewma["Out"] = 1.0  # force a 256-row sized cap
+    h = proc.dispatch_batch(proc.encode_rows(_rows(1000), 0), 1000)
+    _d, m = h.collect()
+    assert m["Transfer_Overflow_Count"] == 1.0
+    # set at overflow, burned once by this batch's own observation
+    assert proc.transfer_boost["Out"] == OVERFLOW_BOOST_BATCHES - 1
+    big = 1 << 20
+    boosted = proc.transfer_capacity("Out", big)
+    proc.transfer_boost["Out"] = 0
+    plain = proc.transfer_capacity("Out", big)
+    assert boosted == 2 * plain  # doubled headroom, same pow2 ladder
+    # expiry: after N observations the boost is gone
+    proc.transfer_boost["Out"] = 2
+    proc.observe_transfer_counts({"Out": 1000})
+    proc.observe_transfer_counts({"Out": 1000})
+    assert proc.transfer_boost["Out"] == 0
+    assert proc.transfer_capacity("Out", big) == plain
+
+
+def test_collect_counts_is_cheap_and_idempotent(tmp_path):
+    """collect_counts parses the packed vector once (the batch's only
+    blocking read) and caches; Sync_CountsBytes reports its wire
+    cost."""
+    proc = _proc(tmp_path)
+    h = proc.dispatch_batch(proc.encode_rows(_rows(10), 0), 1000)
+    bc = h.collect_counts()
+    assert bc.dataset_counts == {"Out": 10}
+    assert bc.counts.nbytes < 1024  # a few hundred bytes, not tables
+    assert h.collect_counts() is bc  # cached sync point
+    _d, m = h.collect_tables()
+    assert m["Sync_CountsBytes"] == float(bc.counts.nbytes)
+    assert m["Output_Out_Events_Count"] == 10.0
+
+
+def test_background_landing_rows_match_sync_collect(tmp_path):
+    """Golden: counts-only sync on the dispatch thread + table landing
+    on a background thread — with the NEXT batch already dispatched
+    (transfer genuinely overlapped) — produces byte-identical rows and
+    counts vs the synchronous collect() path."""
+    bg = _proc(tmp_path / "bg")
+    sync = _proc(tmp_path / "sync", {
+        "datax.job.process.pipeline.outputslots": "false",
+    })
+    seqs = [37, 301, 5, 301, 64]
+    with ThreadPoolExecutor(1, thread_name_prefix="landing") as pool:
+        prev = None  # (future of batch N-1's landing, golden datasets)
+        for i, n in enumerate(seqs):
+            t_ms = 1000 * (i + 1)
+            golden, _gm = sync.process_batch(
+                sync.encode_rows(_rows(n), 0), t_ms
+            )
+            h = bg.dispatch_batch(bg.encode_rows(_rows(n), 0), t_ms)
+            h.collect_counts()  # the dispatch thread's only block
+            fut = pool.submit(h.collect_tables)
+            if prev is not None:
+                datasets, metrics = prev[0].result()
+                assert datasets["Out"] == prev[1]["Out"]
+                assert metrics["Sync_CountsBytes"] > 0
+            prev = (fut, golden)
+        datasets, _m = prev[0].result()
+        assert datasets["Out"] == prev[1]["Out"]
+
+
+def test_output_slots_rotate_and_stay_correct(tmp_path):
+    """The donated A/B slot rotation: consecutive batches alternate
+    slot parity per (output, capacity) and results stay golden-equal to
+    a slotless processor across cap changes and reuse."""
+    proc = _proc(tmp_path / "slots")
+    plain = _proc(tmp_path / "plain", {
+        "datax.job.process.pipeline.outputslots": "false",
+        "datax.job.process.pipeline.sizedtransfer": "false",
+    })
+    assert proc.output_slots_enabled and not plain.output_slots_enabled
+    for i, n in enumerate([10, 20, 30, 40, 50]):
+        t_ms = 1000 * (i + 1)
+        d, _ = proc.process_batch(proc.encode_rows(_rows(n), 0), t_ms)
+        g, _ = plain.process_batch(plain.encode_rows(_rows(n), 0), t_ms)
+        assert d["Out"] == g["Out"]
+    # after the first (full-capacity) batch the sized cap settles at
+    # 256: the (Out, 256) ring holds OUTPUT_SLOT_BUFFERS slots and the
+    # parity cursor advanced once per batch
+    assert ("Out", 256) in proc._slots
+    assert len(proc._slots[("Out", 256)]) == OUTPUT_SLOT_BUFFERS
+    # 5 batches alternated A/B: the cursor ends on the odd parity
+    assert proc._slot_parity["Out"] % OUTPUT_SLOT_BUFFERS == 1
+    # all landed batches released their slots for donation
+    for slot in proc._slots[("Out", 256)]:
+        assert slot is not None and slot[1].is_set()
+
+
+def test_slot_contention_falls_back_to_fresh_buffers(tmp_path):
+    """A slot whose previous transfer has NOT landed is never donated:
+    the pack falls back to fresh buffers (counted) instead of
+    clobbering the in-flight copy or blocking the dispatch loop."""
+    proc = _proc(tmp_path)
+    hs = []
+    for i in range(OUTPUT_SLOT_BUFFERS + 1):
+        # dispatch 3 batches without collecting: the third reuses the
+        # first batch's parity while its landing event is still unset
+        hs.append(proc.dispatch_batch(
+            proc.encode_rows(_rows(8), 0), 1000 * (i + 1)
+        ))
+    results = [h.collect() for h in hs]
+    # the shared counter drains into whichever collect runs first
+    contended = sum(
+        m.get("Transfer_SlotContended_Count", 0.0) for _d, m in results
+    )
+    assert contended == 1.0
+    for d, _m in results:
+        assert len(d["Out"]) == 8
