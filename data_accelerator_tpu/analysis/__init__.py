@@ -4,7 +4,7 @@ Design-time counterpart to the runtime compiler — reuses the production
 codegen + parsers so a bad flow config fails in milliseconds with a
 ``DXnnn``-coded diagnostic instead of minutes into a deployed job.
 
-Four tiers:
+Five tiers:
 
 - the semantic tier (``analyze_flow``): reference resolution, type
   propagation, legality, dead flow, device-compilation risk;
@@ -18,12 +18,18 @@ Four tiers:
   *set* of flows against a fleet spec — first-fit-decreasing placement
   consuming the DX2xx cost model plus the DX4xx capacity/interference
   lints (``fleetcheck.py``); also the runtime placement oracle behind
-  ``serve/jobs.py``'s admission gate.
+  ``serve/jobs.py``'s admission gate;
+- the compile tier (``analyze_flow_compile``): enumerate every jit
+  entry point the flow will ever dispatch, lower each over
+  ``jax.eval_shape`` avals, prove the signature set finite and stable
+  — the DX6xx lints — and emit the AOT **compile manifest** the
+  runtime warms from at init (``compilecheck.py``).
 
 CLI: ``python -m data_accelerator_tpu.analysis flow.json [--json]
-[--device [--chips N]] [--udfs] [--fleet [--fleet-spec=spec.json]]``
+[--device [--chips N]] [--udfs] [--fleet [--fleet-spec=spec.json]]
+[--compile [--manifest=m.json] [--manifest-out=m.json]] [--all]``
 (non-zero exit on error-severity diagnostics, optional tiers included
-when requested).
+when requested; ``--all`` runs every tier in one invocation).
 """
 
 from .analyzer import (
@@ -40,6 +46,12 @@ from .deviceplan import (
     analyze_flow_device,
     analyze_processor,
     combined_report_dict,
+)
+from .compilecheck import (
+    MANIFEST_VERSION,
+    CompileSurfaceReport,
+    analyze_flow_compile,
+    analyze_processor_compile,
 )
 from .diagnostics import (
     CODES,
@@ -74,6 +86,8 @@ from .udfcheck import (
 __all__ = [
     "AnalysisReport",
     "CODES",
+    "CompileSurfaceReport",
+    "MANIFEST_VERSION",
     "DEFAULT_CHIPS",
     "DEFAULT_FLEET_CHIPS",
     "DEFAULT_MAX_STATE_ROWS",
@@ -97,9 +111,11 @@ __all__ = [
     "analyze_fleet",
     "analyze_fleet_flows",
     "analyze_flow",
+    "analyze_flow_compile",
     "analyze_flow_device",
     "analyze_flow_udfs",
     "analyze_processor",
+    "analyze_processor_compile",
     "analyze_script",
     "check_udf_object",
     "combined_report_dict",

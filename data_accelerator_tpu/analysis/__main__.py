@@ -3,6 +3,8 @@
     python -m data_accelerator_tpu.analysis flow.json [flow2.json ...]
         [--json] [--device] [--chips=N] [--udfs]
         [--fleet] [--fleet-spec=spec.json]
+        [--compile] [--manifest=m.json] [--manifest-out=m.json]
+        [--all]
 
 Each argument is a flow config file: either a designer gui JSON or a
 full flow document (``{"gui": {...}}``). Prints one line per diagnostic
@@ -33,6 +35,23 @@ default fleet (8 chips x 16 GiB, the MULTICHIP slice); keys: chips,
 hbmPerChipBytes, headroomFraction, d2hBytesPerSecPerChip,
 iciBytesPerSecPerChip, iciTopology. With ``--json`` the report gains a
 ``fleet`` section carrying the placement plan. Same exit contract.
+
+``--compile`` runs the compile-surface tier
+(``analysis/compilecheck.py``): every jit entry point the flow will
+ever dispatch — the fused step plus one transfer helper per reachable
+(output x pow2 capacity bucket) — is enumerated and lowered over
+``jax.eval_shape`` avals (tracing only, no device execution), the
+DX6xx finiteness/stability lints run, and the AOT **compile manifest**
+is emitted (in ``--json`` under ``compile.manifest``;
+``--manifest-out=<file>`` writes it standalone — single flow only).
+``--manifest=<file>`` additionally checks a previously emitted manifest
+for drift against the fresh lowering (DX602 donation mismatch, DX603
+aval/digest drift). Same exit contract.
+
+``--all`` runs every tier in one invocation (semantic + device + udfs
++ fleet + compile) with one merged ``--json`` report (single
+``schemaVersion``, combined diagnostics, same 0/1/2 exit contract) —
+one CI call instead of five flags.
 
 Unknown ``--`` flags are rejected with exit 2 (a typo like ``--devcie``
 must not silently skip a tier and report a false clean pass).
@@ -119,8 +138,10 @@ def _print_fleet_plan(fleet) -> None:
 
 # flags the CLI understands; anything else --prefixed is a usage error
 # (a typo like --devcie must not silently skip a tier)
-KNOWN_FLAGS = {"--json", "--device", "--udfs", "--fleet"}
-KNOWN_VALUE_FLAGS = ("--chips=", "--fleet-spec=")
+KNOWN_FLAGS = {"--json", "--device", "--udfs", "--fleet", "--compile",
+               "--all"}
+KNOWN_VALUE_FLAGS = ("--chips=", "--fleet-spec=", "--manifest=",
+                     "--manifest-out=")
 
 
 def main(argv: List[str]) -> int:
@@ -128,11 +149,15 @@ def main(argv: List[str]) -> int:
     # eval on the CPU backend before any jax import
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     as_json = "--json" in argv
-    device_tier = "--device" in argv
-    udf_tier = "--udfs" in argv
-    fleet_tier = "--fleet" in argv
+    all_tiers = "--all" in argv
+    device_tier = "--device" in argv or all_tiers
+    udf_tier = "--udfs" in argv or all_tiers
+    fleet_tier = "--fleet" in argv or all_tiers
+    compile_tier = "--compile" in argv or all_tiers
     chips: Optional[int] = None
     fleet_spec_path: Optional[str] = None
+    manifest_path: Optional[str] = None
+    manifest_out: Optional[str] = None
     for a in argv:
         if not a.startswith("--"):
             continue
@@ -146,6 +171,10 @@ def main(argv: List[str]) -> int:
                 return 2
         elif a.startswith("--fleet-spec="):
             fleet_spec_path = a.split("=", 1)[1]
+        elif a.startswith("--manifest="):
+            manifest_path = a.split("=", 1)[1]
+        elif a.startswith("--manifest-out="):
+            manifest_out = a.split("=", 1)[1]
         else:
             print(f"unknown flag: {a}", file=sys.stderr)
             print(__doc__.strip(), file=sys.stderr)
@@ -154,11 +183,27 @@ def main(argv: List[str]) -> int:
     if not paths:
         print(__doc__.strip(), file=sys.stderr)
         return 2
+    if manifest_out and len(paths) > 1:
+        print("--manifest-out accepts a single flow", file=sys.stderr)
+        return 2
 
     from .analyzer import analyze_flow
+    from .compilecheck import analyze_flow_compile
     from .deviceplan import analyze_flow_device, combined_report_dict
     from .diagnostics import REPORT_SCHEMA_VERSION
     from .udfcheck import analyze_flow_udfs
+
+    shipped_manifest = None
+    if manifest_path is not None:
+        try:
+            with open(manifest_path, "r", encoding="utf-8") as f:
+                shipped_manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            print(
+                f"{manifest_path}: cannot read manifest: {e}",
+                file=sys.stderr,
+            )
+            return 2
 
     fleet_spec = None
     if fleet_spec_path is not None:
@@ -187,23 +232,36 @@ def main(argv: List[str]) -> int:
         report = analyze_flow(flow)
         device = analyze_flow_device(flow, chips=chips) if device_tier else None
         udfs = analyze_flow_udfs(flow) if udf_tier else None
+        comp = (
+            analyze_flow_compile(flow, manifest=shipped_manifest)
+            if compile_tier else None
+        )
         any_errors |= not report.ok
         if device is not None:
             any_errors |= not device.ok
         if udfs is not None:
             any_errors |= not udfs.ok
+        if comp is not None:
+            any_errors |= not comp.ok
+            if manifest_out and comp.manifest is not None:
+                with open(manifest_out, "w", encoding="utf-8") as f:
+                    json.dump(comp.manifest, f, indent=1)
         if as_json:
-            if device is not None or udfs is not None:
+            if device is not None or udfs is not None or comp is not None:
                 json_out.append({
                     "file": path,
-                    **combined_report_dict(report, device, udfs),
+                    **combined_report_dict(
+                        report, device, udfs, compile_surface=comp
+                    ),
                 })
             else:
                 json_out.append({"file": path, **report.to_dict()})
         else:
             diags = list(report.diagnostics) + (
                 list(device.diagnostics) if device is not None else []
-            ) + (list(udfs.diagnostics) if udfs is not None else [])
+            ) + (list(udfs.diagnostics) if udfs is not None else []) + (
+                list(comp.diagnostics) if comp is not None else []
+            )
             for d in diags:
                 print(f"{path}: {d.render()}")
             n_e = len([d for d in diags if d.is_error])
@@ -219,6 +277,15 @@ def main(argv: List[str]) -> int:
                         f"{u.kind or 'unloadable'} ({u.path}) "
                         f"analyzed={roles}"
                     )
+            if comp is not None and comp.entries:
+                cd = comp.compile_dict()
+                print(
+                    f"{path}: compile surface: {cd['entries']} entries "
+                    f"(1 step + {cd['helperEntries']} transfer-helper "
+                    f"over buckets {cd['buckets']}), "
+                    f"{'stable' if cd['stable'] else 'OPEN'}, "
+                    f"jit-cache cap {cd['jitCacheCap']}"
+                )
 
     fleet = None
     if fleet_tier:

@@ -236,14 +236,17 @@ def _ordered(diags: List[Diagnostic]) -> List[Diagnostic]:
 
 def combined_report_dict(
     base: AnalysisReport, device: Optional[DevicePlanReport] = None,
-    udfs=None, fleet=None,
+    udfs=None, fleet=None, compile_surface=None,
 ) -> dict:
-    """Merge the semantic tier with the optional device, UDF and fleet
-    tiers into one response: a superset of ``AnalysisReport.to_dict()``
-    plus a ``device`` cost report, a ``udfs`` summary and/or a ``fleet``
-    placement plan — what ``flow/validate`` returns with ``device:
-    true`` / ``udfs: true`` / ``fleet: true`` and what the CLI's
-    ``--device``/``--udfs`` ``--json`` prints."""
+    """Merge the semantic tier with the optional device, UDF, fleet and
+    compile tiers into one response: a superset of
+    ``AnalysisReport.to_dict()`` plus a ``device`` cost report, a
+    ``udfs`` summary, a ``fleet`` placement plan and/or a ``compile``
+    surface+manifest — what ``flow/validate`` returns with ``device:
+    true`` / ``udfs: true`` / ``fleet: true`` / ``compile: true`` (or
+    ``all: true``) and what the CLI's tier flags (or ``--all``)
+    ``--json`` print: one ``schemaVersion``, one merged diagnostics
+    list, one exit contract."""
     from .diagnostics import REPORT_SCHEMA_VERSION
 
     diags = list(base.diagnostics)
@@ -253,6 +256,8 @@ def combined_report_dict(
         diags += list(udfs.diagnostics)
     if fleet is not None:
         diags += list(fleet.diagnostics)
+    if compile_surface is not None:
+        diags += list(compile_surface.diagnostics)
     diags = _ordered(diags)
     errors = [d for d in diags if d.is_error]
     out = {
@@ -268,6 +273,8 @@ def combined_report_dict(
         out["udfs"] = udfs.udfs_dict()
     if fleet is not None:
         out["fleet"] = fleet.fleet_dict()
+    if compile_surface is not None:
+        out["compile"] = compile_surface.compile_dict()
     return out
 
 

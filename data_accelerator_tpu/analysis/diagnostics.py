@@ -178,6 +178,22 @@ CODES: Dict[str, tuple] = {
               "derive grid/BlockSpec from static shapes only and always pass out_shape=jax.ShapeDtypeStruct(...)"),
     "DX310": (SEV_ERROR, "UDF conf entry does not load: bad package.module:attr, non-callable target, or aggregate without reduce",
               "point class/module at an importable UDF object or zero-arg factory; aggregates must provide reduce"),
+    # -- pass 9: compile surface (analysis/compilecheck.py, the
+    #    --compile tier: enumerate every jit entry point, lower each
+    #    over eval_shape avals, prove the signature set finite and
+    #    stable, emit the AOT compile manifest) -----------------------
+    "DX600": (SEV_WARNING, "open trace surface: UDF interval refresh or unbounded dictionary growth re-traces the step with new signatures, so the jit cache (and any AOT promise) grows without bound",
+              "drop the on_interval refresh or bound the dictionary (process.stringdictionary.maxsize) so the manifest covers every signature the flow can dispatch"),
+    "DX601": (SEV_WARNING, "reachable sized-transfer capacity buckets alone exceed the transfer-helper jit cache bound: steady-state LRU eviction recompiles helpers mid-stream",
+              "lower the batch capacity (fewer pow2 buckets) or raise process.compile.jitcachecap above the lattice size"),
+    "DX602": (SEV_ERROR, "manifest donation/aliasing mismatch: a shipped manifest entry's donated argnums disagree with the runtime's donation contract",
+              "regenerate the manifest (--compile emits it); never hand-edit donation patterns — they alias live device buffers"),
+    "DX603": (SEV_ERROR, "manifest-vs-lowering drift: a shipped manifest's entries/avals/lowering digests no longer match what this flow compiles to",
+              "regenerate the manifest after any flow, schema, capacity or engine change (warm starts from a stale manifest recompile at dispatch, surfacing as Compile_WarmMiss_Count)"),
+    "DX690": (SEV_ERROR, "compile-surface lowering failed: the fused step (or a transfer helper) cannot trace/lower over the derived avals",
+              "fix the statement per the lowering error (it is the production compiler's own failure, seen early)"),
+    "DX691": (SEV_WARNING, "compile-surface analysis unavailable: no concrete input schema, design-time-unloadable UDF, or unreadable reference data",
+              "inline the input schema JSON, make UDF modules importable on the control plane, and keep refdata CSVs readable at design time"),
 }
 
 # which pass each code family belongs to (for grouping/reporting)
@@ -193,6 +209,8 @@ PASS_NAMES = {
     "DX31": "udf tracing safety",
     "DX40": "fleet capacity",
     "DX41": "fleet interference",
+    "DX60": "compile surface",
+    "DX69": "compile surface",
 }
 
 # version of every ``--json`` report shape the analysis tiers emit (the

@@ -1,0 +1,191 @@
+"""Persistent XLA compilation cache, optionally shared via objstore://.
+
+The runtime half of the zero-cold-start path (ISSUE: compile manifest +
+AOT warm): ``FlowProcessor._aot_warm`` compiles every manifest entry at
+init; with this cache enabled the compiles inside that warm resolve
+from serialized executables on disk — and, when a shared object store
+is configured, newly compiled entries are pushed back so the NEXT start
+(restart, preemption recovery, scale-out replica, a LiveQuery kernel
+pool on another box) deserializes instead of compiling.
+
+Layering:
+
+- **local dir** (``datax.job.process.compile.cachedir``): jax's own
+  persistent compilation cache (``jax_compilation_cache_dir``), tuned
+  so every entry persists (no min-size/min-compile-time gating — a
+  restart should never recompile something this process already paid
+  for).
+- **shared store** (``datax.job.process.compile.cacheurl``, an
+  ``objstore://host:port/bucket/prefix`` URL): ``enable()`` pulls
+  entries absent locally before arming the cache; ``push()`` uploads
+  entries created since ``enable()``. Cache files are opaque bytes to
+  us — jax names them by its own cache key (backend + jaxlib version +
+  computation fingerprint), so a stale entry can never be *loaded*
+  wrongly, only ignored.
+
+File counting is at jax-cache-entry granularity (the ``*-cache``
+files; ``*-atime`` bookkeeping files are ignored), which is what the
+``Compile_Cache_{Hit,Miss}_Count`` metrics report.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import List, Optional, Set, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+def _reset_jax_cache() -> None:
+    """Drop jax's memoized cache object so a config change made after
+    earlier compiles (the normal case: the engine jits plenty before a
+    flow's cache conf is read) actually takes effect."""
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001 — private API; degrade to no cache
+        logger.warning("jax compilation-cache reset unavailable")
+
+
+def _parse_objstore_url(url: str) -> Tuple[str, str, str]:
+    """objstore://host:port/bucket/prefix -> (endpoint, bucket, prefix)."""
+    if url.startswith("objstore+https://"):
+        scheme, rest = "https", url[len("objstore+https://"):]
+    elif url.startswith("objstore://"):
+        scheme, rest = "http", url[len("objstore://"):]
+    else:
+        raise ValueError(f"not an objstore URL: {url!r}")
+    host, _, bucket_key = rest.partition("/")
+    bucket, _, prefix = bucket_key.partition("/")
+    if not bucket:
+        raise ValueError(f"objstore URL needs a bucket: {url!r}")
+    return f"{scheme}://{host}", bucket, prefix.strip("/")
+
+
+class PersistentCompileCache:
+    """One flow's compile-cache session: local jax cache dir + optional
+    shared objstore layer."""
+
+    def __init__(
+        self, cache_dir: Optional[str] = None,
+        cache_url: Optional[str] = None,
+    ):
+        if not cache_dir and not cache_url:
+            raise ValueError("cache_dir or cache_url required")
+        self.url = cache_url
+        self._client = None
+        self._prefix = ""
+        if cache_url:
+            from ..serve.objectstore import ObjectStoreClient
+
+            endpoint, bucket, prefix = _parse_objstore_url(cache_url)
+            token = os.environ.get("DATAX_OBJSTORE_TOKEN")
+            self._client = ObjectStoreClient(endpoint, bucket, token=token)
+            self._prefix = prefix
+        if not cache_dir:
+            # deterministic local layer per shared prefix so co-located
+            # flows sharing a cacheurl also share the local dir
+            import hashlib
+            import tempfile
+
+            cache_dir = os.path.join(
+                tempfile.gettempdir(), "dxtpu-compile-cache",
+                hashlib.sha256(cache_url.encode()).hexdigest()[:16],
+            )
+        self.dir = cache_dir
+        self._baseline: Set[str] = set()
+        self._prev_config: Optional[tuple] = None
+
+    # -- local entries ---------------------------------------------------
+    def _entries(self) -> List[str]:
+        try:
+            return sorted(
+                fn for fn in os.listdir(self.dir)
+                if not fn.endswith("-atime") and not fn.endswith(".tmp")
+            )
+        except OSError:
+            return []
+
+    def file_count(self) -> int:
+        return len(self._entries())
+
+    # -- lifecycle -------------------------------------------------------
+    def enable(self) -> None:
+        """Pull shared entries, then arm jax's persistent cache at the
+        local dir. Remembers the pre-existing config so ``disable()``
+        can restore it (tests; production leaves it armed so later
+        re-traces also persist)."""
+        os.makedirs(self.dir, exist_ok=True)
+        self.pull()
+        import jax
+
+        self._prev_config = (
+            jax.config.jax_compilation_cache_dir,
+            jax.config.jax_persistent_cache_min_entry_size_bytes,
+            jax.config.jax_persistent_cache_min_compile_time_secs,
+        )
+        jax.config.update("jax_compilation_cache_dir", self.dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        _reset_jax_cache()
+        self._baseline = set(self._entries())
+
+    def disable(self) -> None:
+        """Restore the jax cache config captured by ``enable()``."""
+        if self._prev_config is None:
+            return
+        import jax
+
+        d, s, t = self._prev_config
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", s)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", t)
+        _reset_jax_cache()
+        self._prev_config = None
+
+    # -- shared layer ----------------------------------------------------
+    def _key(self, fn: str) -> str:
+        return f"{self._prefix}/{fn}" if self._prefix else fn
+
+    def pull(self) -> int:
+        """Download shared entries absent locally. Best-effort: a dead
+        store degrades to the local-only cache."""
+        if self._client is None:
+            return 0
+        n = 0
+        try:
+            have = set(self._entries())
+            for key in self._client.list(self._prefix):
+                fn = key.rsplit("/", 1)[-1]
+                if fn in have or fn.endswith("-atime"):
+                    continue
+                data = self._client.get(key)
+                if data is None:
+                    continue
+                path = os.path.join(self.dir, fn)
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, path)
+                n += 1
+        except Exception as e:  # noqa: BLE001 — shared layer is best-effort
+            logger.warning("compile-cache pull failed: %s", e)
+        return n
+
+    def push(self) -> int:
+        """Upload entries created since ``enable()`` (the compiles this
+        process actually paid for) and return how many there were —
+        the ``Compile_Cache_Miss_Count`` number. With no shared store
+        the new-entry count still reports (local misses)."""
+        new = [fn for fn in self._entries() if fn not in self._baseline]
+        if self._client is not None:
+            for fn in new:
+                try:
+                    with open(os.path.join(self.dir, fn), "rb") as f:
+                        self._client.put(self._key(fn), f.read())
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    logger.warning("compile-cache push %s failed: %s", fn, e)
+        self._baseline |= set(new)
+        return len(new)

@@ -133,6 +133,18 @@ class MetricName:
         r"Conformance_D2HBytes_Ratio",
         r"Conformance_Occupancy_[A-Za-z0-9_.]+_Ratio",
         r"Conformance_Drift_Count",
+        # AOT compile + persistent compilation cache
+        # (runtime/processor.py process.compile.*): init-time warm cost,
+        # persistent-cache hit/miss counts at cache-entry granularity,
+        # warm-start promises missed (a dispatch compiled after an AOT
+        # warm — the runtime face of DX604), shipped-manifest drift
+        # detected at warm time (the runtime face of DX603), and
+        # LRU evictions from the bounded transfer-helper jit caches
+        r"Compile_ColdStart_Ms",
+        r"Compile_Cache_(Hit|Miss)_Count",
+        r"Compile_WarmMiss_Count",
+        r"Compile_ManifestDrift_Count",
+        r"Compile_JitCacheEvict_Count",
         # alert engine (obs/alerts.py): count of currently-firing rules,
         # exported every evaluation so dashboards can chart alert state
         r"Alerts_Firing",

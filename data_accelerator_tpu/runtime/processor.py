@@ -29,6 +29,7 @@ import logging
 import os
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple, Union
 
@@ -80,6 +81,22 @@ OVERFLOW_BOOST_BATCHES = 8
 # sets per (output, capacity bucket), alternating A/B so batch N+1's
 # step never clobbers batch N's in-flight background D2H copy
 OUTPUT_SLOT_BUFFERS = 2
+
+# donation contract of the fused step jit: the window rings (positional
+# arg 1) are donated so XLA updates them in place; nothing else is.
+# The compile-surface analyzer (analysis/compilecheck.py) records this
+# pattern per manifest entry — DX602 fires when a shipped manifest
+# disagrees with it.
+STEP_DONATE_ARGNUMS = (1,)
+
+# bound on the per-capacity-bucket jit caches of the transfer helpers
+# (_slice_table/_pack_slot): one jitted closure per (helper kind, pow2
+# capacity bucket), LRU-evicted above this cap so a wandering EWMA can
+# never grow the cache forever. Conf datax.job.process.compile.
+# jitcachecap overrides; the DX601 compile-surface lint uses the SAME
+# constant to flag flows whose reachable bucket lattice alone already
+# exceeds the bound (analysis/compilecheck.py).
+DEFAULT_JIT_CACHE_CAP = 32
 
 _CTYPE_TO_PLAN = {
     ColType.LONG: "long",
@@ -283,6 +300,246 @@ def pack_raw(
     )
 
 
+def build_step_fn(
+    ts_col: Optional[str],
+    windows: Dict[str, Tuple[str, float]],
+    output_datasets: List[str],
+    state_names: List[str],
+    refdata_names: List[str],
+    ring_tables: List[str],
+    pipeline,
+    source_targets: List[Tuple[str, str]],  # (source name, target table)
+    proj_views: Dict[str, list],
+    primary_target: str,
+):
+    """Build the fused per-batch step function from its compiled parts.
+
+    The ONE definition of the whole-flow device program: ``FlowProcessor
+    ._jit_step`` jits exactly this, and the compile-surface analyzer
+    (``analysis/compilecheck.py``) lowers exactly this over eval_shape
+    avals to prove the trace surface closed — sharing the builder is
+    what makes the emitted compile manifest drift-free by construction
+    (the DX603 byte-exactness contract)."""
+
+    def step(
+        raw: Dict[str, TableData],
+        rings: Dict[str, WindowBuffers],
+        state: Dict[str, TableData],
+        refdata: Dict[str, TableData],
+        base_s: jnp.ndarray,
+        now_rel_ms: jnp.ndarray,
+        counter: jnp.ndarray,
+        delta_ms: jnp.ndarray,
+        aux: Dict[str, jnp.ndarray],
+    ):
+        # 1. per-source projection into its target table (each source
+        # gets its own env so `Raw` binds to ITS raw table)
+        projected: Dict[str, TableData] = {}
+        for sname_, target_ in source_targets:
+            rt = raw[sname_]
+            if isinstance(rt, PackedRaw):
+                rt = rt.unpack()  # split the single-transfer matrix
+            env: Dict[str, TableData] = {
+                "Raw": rt,
+                DatasetName.DataStreamRaw: rt,
+                "__aux": aux,
+            }
+            for v in proj_views[sname_]:
+                env[v.name] = v.fn(env, base_s, now_rel_ms)
+            projected[target_] = env[target_]
+
+        # 2. ring updates (one ring per windowed table; each ring's
+        # slot index derives from the shared batch counter)
+        new_rings: Dict[str, WindowBuffers] = {}
+        for table in ring_tables:
+            buf = rings[table]
+            slot = jax.lax.rem(
+                counter, jnp.asarray(buf.valid.shape[0], jnp.int32)
+            )
+            new_rings[table] = update_buffers(
+                buf, projected[table], slot, delta_ms, ts_col
+            )
+
+        tables: Dict[str, TableData] = dict(projected)
+        for wname, (table, dur_s) in windows.items():
+            tables[wname] = window_table(
+                new_rings[table], int(dur_s * 1000), now_rel_ms, ts_col
+            )
+        for rname in refdata_names:
+            tables[rname] = refdata[rname]
+        for sname in state_names:
+            tables[sname] = state[sname]
+
+        out = pipeline.run(tables, base_s, now_rel_ms, aux=aux)
+
+        new_state = {n: out.get(n, state[n]) for n in state_names}
+
+        # compact outputs device-side (valid rows to the front) so the
+        # host transfers only [:count] rows — the device->host hop is
+        # the expensive boundary (a network tunnel on split hosts),
+        # so bytes AND round-trips are minimized: all per-batch
+        # scalars ride ONE packed vector.
+        from ..ops.compact import compact_indices
+
+        datasets = {}
+        counts = [projected[primary_target].count()]
+        for n in output_datasets:
+            t = out[n]
+            idx, ov = compact_indices(t.valid, t.valid.shape[0])
+            datasets[n] = TableData(
+                {c: v[idx] if v.shape[:1] == t.valid.shape else v
+                 for c, v in t.cols.items()},
+                ov,
+            )
+            counts.append(t.count())
+        # fixed layout: per output one groups-overflow then one
+        # join-overflow slot; -1 marks "output does not track this
+        # overflow" so the host can keep emitting 0 for ones that do
+        for key in ("__overflow.groups", "__overflow.joins"):
+            for n in output_datasets:
+                counts.append(
+                    out[n].cols[key][0]
+                    if key in out[n].cols
+                    else jnp.asarray(-1, jnp.int32)
+                )
+        # per-target projected input counts (multi-source metrics)
+        for _sname, target_ in source_targets:
+            counts.append(projected[target_].count())
+        counts_vec = jnp.stack(
+            [jnp.asarray(c, jnp.int32) for c in counts]
+        )
+        # plain tuple of pytrees for the jit boundary
+        return (datasets, new_rings, new_state, counts_vec)
+
+    return step
+
+
+def transfer_buckets(full_cap: int) -> List[int]:
+    """Every sized-transfer capacity an output of padded capacity
+    ``full_cap`` can ever be fetched at: the pow2 lattice
+    ``transfer_capacity`` buckets to (engaging only while the sized cap
+    at least halves the copy), plus the full capacity itself (the
+    pre-EWMA / overflow / sized-off fetch). Finite by construction —
+    the compile manifest enumerates the ``_slice_table``/``_pack_slot``
+    entries per bucket from this same lattice, and DX601 fires when it
+    alone outgrows the helper jit-cache bound."""
+    caps: List[int] = []
+    c = _pow2_ceil(MIN_TRANSFER_ROWS)
+    while c * 2 <= full_cap:
+        caps.append(c)
+        c *= 2
+    caps.append(int(full_cap))
+    return caps
+
+
+def source_raw_form(input_type: Optional[str], mesh=None) -> str:
+    """``packed`` when production dispatch ships a source of this input
+    type as the single-matrix PackedRaw (native decoder hot path:
+    single chip, non-local input), else ``columns``. The ONE definition
+    both the runtime (``FlowProcessor._source_raw_form``) and the
+    compile-surface analyzer use — the raw form is part of the step's
+    trace signature, so the two may never disagree."""
+    from ..native import native_available
+
+    itype = (input_type or "local").lower()
+    if mesh is not None or itype in ("", "local"):
+        return "columns"
+    return "packed" if native_available() else "columns"
+
+
+# raw-schema type -> PackedRaw row kind (the bitcast pack_raw applies)
+_PACK_KINDS = {"double": "f32", "boolean": "bool"}
+# raw-schema type -> the numpy dtype the ingest encoders materialize
+_RAW_NP_DTYPES = {"double": np.float32, "boolean": np.bool_}
+
+
+def packed_raw_layout(raw_types: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    """The PackedRaw layout the ingest hot path builds for a raw schema
+    (column order preserved; kinds per the pack_raw bitcast rules).
+    Layout is pytree aux data, i.e. part of the step's jit cache key —
+    the compile manifest derives it from the same map."""
+    return tuple(
+        (c, _PACK_KINDS.get(t, "i32")) for c, t in raw_types.items()
+    )
+
+
+def packed_raw_struct(raw_types: Dict[str, str], capacity: int) -> PackedRaw:
+    """Abstract (ShapeDtypeStruct) PackedRaw for one source — the exact
+    aval the jitted step sees on the packed ingest path."""
+    layout = packed_raw_layout(raw_types)
+    return PackedRaw(
+        jax.ShapeDtypeStruct((len(layout) + 1, capacity), jnp.int32), layout
+    )
+
+
+def aval_signature(tree) -> dict:
+    """Canonical, JSON-stable description of a pytree of avals: the
+    treedef repr (which carries custom-node aux data like the PackedRaw
+    layout — part of the jit cache key) plus every leaf's shape and
+    dtype. Two entries trace-compatible <=> identical signatures."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return {
+        "tree": str(treedef),
+        "leaves": [
+            [list(map(int, l.shape)), str(np.dtype(l.dtype))] for l in leaves
+        ],
+    }
+
+
+def compile_entries_from_avals(
+    step_avals: tuple,
+    out_avals: Dict[str, TableData],
+    sized: bool,
+    slots: bool,
+) -> List[dict]:
+    """Enumerate every jit entry point a flow dispatches — the fused
+    step plus one ``_slice_table``/``_pack_slot`` per (output, capacity
+    bucket) — as manifest-shaped dicts. Shared by the runtime
+    (``FlowProcessor.derive_compile_entries``, which feeds the AOT
+    warm) and the static analyzer (``analysis/compilecheck.py``, which
+    emits the manifest), so the two can only disagree when the flow
+    itself changed (the DX603 drift signal)."""
+    entries: List[dict] = [{
+        "entry": "step",
+        "donate": list(STEP_DONATE_ARGNUMS),
+        "static": {},
+        "avals": aval_signature(step_avals),
+    }]
+    for name in sorted(out_avals):
+        t = out_avals[name]
+        full_cap = int(t.valid.shape[0])
+        sliceable = all(
+            tuple(v.shape[:1]) == tuple(t.valid.shape)
+            for v in t.cols.values()
+        )
+        caps = transfer_buckets(full_cap) if sized else [full_cap]
+        for cap in caps:
+            if slots and sliceable:
+                entries.append({
+                    "entry": f"slice:{name}:{cap}",
+                    "donate": [],
+                    "static": {"cap": cap},
+                    "avals": aval_signature(t),
+                })
+                slot_aval = jax.eval_shape(
+                    functools.partial(_slice_impl, cap=cap), t
+                )
+                entries.append({
+                    "entry": f"pack:{name}:{cap}",
+                    "donate": [1],
+                    "static": {"cap": cap},
+                    "avals": aval_signature((t, slot_aval)),
+                })
+            elif cap < full_cap:
+                entries.append({
+                    "entry": f"slice:{name}:{cap}",
+                    "donate": [],
+                    "static": {"cap": cap},
+                    "avals": aval_signature(t),
+                })
+    return entries
+
+
 @dataclass
 class SourceSpec:
     """One named input stream of a flow: its schema, projection chain,
@@ -406,6 +663,63 @@ class FlowProcessor:
         # checkpoint time (snapshotting a ring the next dispatch has
         # already donated would read a deleted buffer)
         self._device_state_lock = threading.Lock()
+
+        # AOT compile + persistent compilation cache (the zero-cold-
+        # start path, datax.job.process.compile.*): `manifest` carries
+        # the compile manifest config generation embedded (inline JSON,
+        # a file path, or objstore:// — analysis/compilecheck.py emits
+        # it); with `aot` (default on when a manifest is present) every
+        # manifest entry is compiled at INIT instead of first dispatch.
+        # `cachedir`/`cacheurl` route XLA's persistent compilation
+        # cache through a local dir / the shared object store so
+        # restarts and preemption recovery deserialize instead of
+        # recompiling. `jitcachecap` bounds the transfer-helper jit
+        # caches (shared default with the DX601 lint).
+        comp_conf = process_conf.get_sub_dictionary("compile.")
+        cap_conf = comp_conf.get_int_option("jitcachecap")
+        if cap_conf is not None:
+            if cap_conf < 1:
+                raise EngineException(
+                    f"process.compile.jitcachecap must be >= 1, got "
+                    f"{cap_conf}"
+                )
+            set_jit_cache_cap(cap_conf)
+        self.compile_manifest: Optional[dict] = None
+        manifest_raw = _read_maybe_file(comp_conf.get("manifest"))
+        if manifest_raw:
+            try:
+                self.compile_manifest = json.loads(manifest_raw)
+            except ValueError as e:
+                logger.warning("compile manifest does not parse: %s", e)
+        self.aot_enabled = (
+            (comp_conf.get_or_else("aot", "true") or "").lower() != "false"
+        ) and self.compile_manifest is not None
+        self.compile_cache_dir = comp_conf.get("cachedir")
+        self.compile_cache_url = comp_conf.get("cacheurl")
+        # the persistent compilation cache arms for ANY processor that
+        # configures it — AOT or not (LiveQuery kernels have no
+        # manifest, but their per-query compiles still deserialize on
+        # the next create/restart). The AOT warm reuses this instance
+        # for its hit/miss accounting and objstore push.
+        self._compile_cache = None
+        if self.compile_cache_dir or self.compile_cache_url:
+            try:
+                from ..compile.aotcache import PersistentCompileCache
+
+                self._compile_cache = PersistentCompileCache(
+                    self.compile_cache_dir, self.compile_cache_url
+                )
+                self._compile_cache.enable()
+            except Exception as e:  # noqa: BLE001 — cache is an optimization
+                logger.warning("persistent compile cache unavailable: %s", e)
+                self._compile_cache = None
+        # Compile_* metric deltas drained at collect (ColdStart_Ms,
+        # Cache_Hit_Count, Cache_Miss_Count, WarmMiss_Count)
+        self.compile_stats: Dict[str, float] = {}
+        self._aot_warmed = False
+        # step jit-cache size right after the warm: growth past it at
+        # dispatch time means a promised warm start was missed (DX604)
+        self._warm_step_mark: Optional[int] = None
 
         self.interval_s = float(
             input_conf.get_or_else("streaming.intervalinseconds", "1")
@@ -541,6 +855,8 @@ class FlowProcessor:
         self._build_pipeline(output_datasets)
         self._init_device_state()
         self._jit_step()
+        if self.aot_enabled:
+            self._aot_warm()
 
     # -- build -----------------------------------------------------------
     def _planner_config(self, process_conf: SettingDictionary) -> PlannerConfig:
@@ -838,107 +1154,18 @@ class FlowProcessor:
 
     # -- the jitted step --------------------------------------------------
     def _jit_step(self):
-        ts_col = self.timestamp_column
-        windows = dict(self.windows)
-        output_datasets = list(self.output_datasets)
-        state_names = list(self.state_tables)
-        pipeline = self.pipeline
-        specs = list(self.specs.values())
-        proj_views = dict(self.projection_views)
-        refdata_names = list(self.refdata)
-        ring_tables = list(self.ring_slots)
-        primary_target = self.specs[self.primary].target
-
-        def step(
-            raw: Dict[str, TableData],
-            rings: Dict[str, WindowBuffers],
-            state: Dict[str, TableData],
-            refdata: Dict[str, TableData],
-            base_s: jnp.ndarray,
-            now_rel_ms: jnp.ndarray,
-            counter: jnp.ndarray,
-            delta_ms: jnp.ndarray,
-            aux: Dict[str, jnp.ndarray],
-        ):
-            # 1. per-source projection into its target table (each source
-            # gets its own env so `Raw` binds to ITS raw table)
-            projected: Dict[str, TableData] = {}
-            for spec in specs:
-                rt = raw[spec.name]
-                if isinstance(rt, PackedRaw):
-                    rt = rt.unpack()  # split the single-transfer matrix
-                env: Dict[str, TableData] = {
-                    "Raw": rt,
-                    DatasetName.DataStreamRaw: rt,
-                    "__aux": aux,
-                }
-                for v in proj_views[spec.name]:
-                    env[v.name] = v.fn(env, base_s, now_rel_ms)
-                projected[spec.target] = env[spec.target]
-
-            # 2. ring updates (one ring per windowed table; each ring's
-            # slot index derives from the shared batch counter)
-            new_rings: Dict[str, WindowBuffers] = {}
-            for table in ring_tables:
-                buf = rings[table]
-                slot = jax.lax.rem(
-                    counter, jnp.asarray(buf.valid.shape[0], jnp.int32)
-                )
-                new_rings[table] = update_buffers(
-                    buf, projected[table], slot, delta_ms, ts_col
-                )
-
-            tables: Dict[str, TableData] = dict(projected)
-            for wname, (table, dur_s) in windows.items():
-                tables[wname] = window_table(
-                    new_rings[table], int(dur_s * 1000), now_rel_ms, ts_col
-                )
-            for rname in refdata_names:
-                tables[rname] = refdata[rname]
-            for sname in state_names:
-                tables[sname] = state[sname]
-
-            out = pipeline.run(tables, base_s, now_rel_ms, aux=aux)
-
-            new_state = {n: out.get(n, state[n]) for n in state_names}
-
-            # compact outputs device-side (valid rows to the front) so the
-            # host transfers only [:count] rows — the device->host hop is
-            # the expensive boundary (a network tunnel on split hosts),
-            # so bytes AND round-trips are minimized: all per-batch
-            # scalars ride ONE packed vector.
-            from ..ops.compact import compact_indices
-
-            datasets = {}
-            counts = [projected[primary_target].count()]
-            for n in output_datasets:
-                t = out[n]
-                idx, ov = compact_indices(t.valid, t.valid.shape[0])
-                datasets[n] = TableData(
-                    {c: v[idx] if v.shape[:1] == t.valid.shape else v
-                     for c, v in t.cols.items()},
-                    ov,
-                )
-                counts.append(t.count())
-            # fixed layout: per output one groups-overflow then one
-            # join-overflow slot; -1 marks "output does not track this
-            # overflow" so the host can keep emitting 0 for ones that do
-            for key in ("__overflow.groups", "__overflow.joins"):
-                for n in output_datasets:
-                    counts.append(
-                        out[n].cols[key][0]
-                        if key in out[n].cols
-                        else jnp.asarray(-1, jnp.int32)
-                    )
-            # per-target projected input counts (multi-source metrics)
-            for spec in specs:
-                counts.append(projected[spec.target].count())
-            counts_vec = jnp.stack(
-                [jnp.asarray(c, jnp.int32) for c in counts]
-            )
-            # plain tuple of pytrees for the jit boundary
-            return (datasets, new_rings, new_state, counts_vec)
-
+        step = build_step_fn(
+            ts_col=self.timestamp_column,
+            windows=dict(self.windows),
+            output_datasets=list(self.output_datasets),
+            state_names=list(self.state_tables),
+            refdata_names=list(self.refdata),
+            ring_tables=list(self.ring_slots),
+            pipeline=self.pipeline,
+            source_targets=[(s.name, s.target) for s in self.specs.values()],
+            proj_views=dict(self.projection_views),
+            primary_target=self.specs[self.primary].target,
+        )
         self._step_fn = step
         # donate the rings: the old buffers are dead after the step, so
         # XLA updates the (large) window rings in place instead of
@@ -953,10 +1180,10 @@ class FlowProcessor:
                 step,
                 in_shardings=in_shardings,
                 out_shardings=out_shardings,
-                donate_argnums=(1,),
+                donate_argnums=STEP_DONATE_ARGNUMS,
             )
         else:
-            self._step = jax.jit(step, donate_argnums=(1,))
+            self._step = jax.jit(step, donate_argnums=STEP_DONATE_ARGNUMS)
 
     # -- per-batch host path ----------------------------------------------
     def _spec(self, source: Optional[str]) -> SourceSpec:
@@ -1409,6 +1636,161 @@ class FlowProcessor:
         self.retrace_count = 0
         return n
 
+    # -- AOT compile surface (the zero-cold-start path) --------------------
+    def _source_raw_form(self, spec: SourceSpec) -> str:
+        """The raw transfer form (and therefore trace signature) the
+        AOT warm must use for this source — same rule as production
+        dispatch (module-level ``source_raw_form``)."""
+        return source_raw_form(spec.conf.get("inputtype"), self.mesh)
+
+    def _warm_raw(self) -> Dict[str, Union[TableData, PackedRaw]]:
+        """Zero-filled per-source raw batches in the exact form (and
+        therefore trace signature) production dispatch will use."""
+        raw: Dict[str, Union[TableData, PackedRaw]] = {}
+        for name, spec in self.specs.items():
+            if self._source_raw_form(spec) == "packed":
+                np_cols = {
+                    c: np.zeros(spec.capacity, _RAW_NP_DTYPES.get(t, np.int32))
+                    for c, t in spec.raw_schema.types.items()
+                }
+                raw[name] = pack_raw(np_cols, np.zeros(spec.capacity, np.bool_))
+            else:
+                raw[name] = self._empty_raw(spec)
+        return raw
+
+    def _step_input_avals(self) -> tuple:
+        """The 9-argument aval tuple of the fused step — the trace
+        signature the jit cache keys on, derived from this processor's
+        own device state (so it can never drift from what dispatch
+        passes)."""
+        def aval(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+        tm = jax.tree_util.tree_map
+        raw = {n: tm(aval, r) for n, r in self._warm_raw().items()}
+        rings = tm(aval, self.window_buffers)
+        state = tm(aval, self.state_data)
+        refdata = {n: tm(aval, t) for n, (_s, t) in self.refdata.items()}
+        scalar = jax.ShapeDtypeStruct((), jnp.int32)
+        aux = tm(aval, self.aux_tables.tables())
+        return (raw, rings, state, refdata, scalar, scalar, scalar, scalar,
+                aux)
+
+    def derive_compile_entries(self) -> List[dict]:
+        """Every jit entry point this processor can ever dispatch, as
+        manifest-shaped dicts (entry name, aval signature, static args,
+        donation pattern) — the runtime side of the DX603 byte-
+        exactness contract: the compile analyzer derives the same list
+        statically from the flow config."""
+        step_avals = self._step_input_avals()
+        out_avals = jax.eval_shape(self._step_fn, *step_avals)[0]
+        return compile_entries_from_avals(
+            step_avals, out_avals,
+            sized=self.sized_transfer, slots=self.output_slots_enabled,
+        )
+
+    def _warm_helpers(self) -> None:
+        """Execute every reachable transfer-helper entry once — one
+        ``_slice_table``/``_pack_slot`` per (output, capacity bucket)
+        from the same lattice the manifest enumerates — so sized
+        transfer never pays a first-use trace mid-stream."""
+        step_avals = self._step_input_avals()
+        out_avals = jax.eval_shape(self._step_fn, *step_avals)[0]
+        for name in sorted(out_avals):
+            t = out_avals[name]
+            full_cap = int(t.valid.shape[0])
+            sliceable = all(
+                tuple(v.shape[:1]) == tuple(t.valid.shape)
+                for v in t.cols.values()
+            )
+            zero_full = TableData(
+                {c: jnp.zeros(a.shape, a.dtype) for c, a in t.cols.items()},
+                jnp.zeros(t.valid.shape, t.valid.dtype),
+            )
+            caps = (
+                transfer_buckets(full_cap) if self.sized_transfer
+                else [full_cap]
+            )
+            for cap in caps:
+                if self.output_slots_enabled and sliceable:
+                    sliced = _slice_table(zero_full, cap)
+                    _pack_slot(zero_full, sliced, cap)  # donates `sliced`
+                elif cap < full_cap:
+                    _slice_table(zero_full, cap)
+
+    def _aot_warm(self) -> None:
+        """AOT-compile every manifest entry at init instead of first
+        dispatch: run one zero-filled batch through the jitted step
+        (the exact production trace signature, so the first real
+        dispatch hits a warm jit cache) and execute every reachable
+        (output x capacity bucket) transfer helper once. With a
+        persistent compilation cache configured
+        (``process.compile.cachedir``/``.cacheurl``) the XLA compiles
+        inside the warm resolve from the cache — hits/misses counted at
+        cache-file granularity — and newly compiled entries are pushed
+        back through ``objstore://`` so the NEXT start (restart,
+        preemption recovery, scale-out replica) deserializes instead
+        of compiling. A warm failure never kills init: the flow falls
+        back to compile-at-first-dispatch, loudly."""
+        t0 = time.time()
+        cache = self._compile_cache
+        pre_files = cache.file_count() if cache is not None else 0
+        try:
+            # manifest-vs-runtime drift check (the runtime face of
+            # DX603): a manifest generated for a different flow shape
+            # still warms — the signatures it promised just won't all
+            # be the ones dispatch uses, which the drift count surfaces
+            entries = self.derive_compile_entries()
+            shipped = {
+                e.get("entry"): e
+                for e in (self.compile_manifest or {}).get("entries", [])
+                if isinstance(e, dict)
+            }
+            drift = sum(
+                1 for e in entries
+                if e["entry"] not in shipped
+                or shipped[e["entry"]].get("avals") != e["avals"]
+                or list(shipped[e["entry"]].get("donate") or [])
+                != list(e["donate"])
+            )
+            if drift:
+                logger.warning(
+                    "compile manifest drift (DX603): %d of %d entries "
+                    "disagree with this flow's lowering — regenerate "
+                    "the manifest", drift, len(entries),
+                )
+                self.compile_stats["ManifestDrift_Count"] = float(drift)
+            # compile the fused step at the exact production trace
+            # signature (zero-filled batch, production raw form) and
+            # every reachable transfer helper. The warm batch is NEVER
+            # collected: collect_tables() would overwrite the state
+            # tables' standby snapshot with warm-derived rows — only
+            # the counts sync (which completes the device work) runs.
+            handle = self.dispatch_batch(self._warm_raw(), batch_time_ms=0)
+            handle.collect_counts()
+            handle.abandon()
+            self._warm_helpers()
+            self._aot_warmed = True
+        except Exception:  # noqa: BLE001 — warm must never fail the flow
+            logger.exception("AOT warm failed; first dispatch will compile")
+        finally:
+            # the warm batch must leave no trace in adaptive state: a
+            # zero-count EWMA would size the first real batches at the
+            # minimum bucket and force overflow re-fetches
+            self.reset_state()
+            self.transfer_ewma.clear()
+            self.transfer_boost.clear()
+            self.transfer_stats.clear()
+        if cache is not None:
+            try:
+                new_files = cache.push()
+                self.compile_stats["Cache_Hit_Count"] = float(pre_files)
+                self.compile_stats["Cache_Miss_Count"] = float(new_files)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("compile cache push failed: %s", e)
+        self._warm_step_mark = self._step_cache_size()
+        self.compile_stats["ColdStart_Ms"] = (time.time() - t0) * 1000.0
+
     def commit(self) -> None:
         """Commit state-table pointers after sinks succeed."""
         for st in self.state_tables.values():
@@ -1440,15 +1822,7 @@ _SET_EVENT = threading.Event()
 _SET_EVENT.set()
 
 
-@functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
-def _pack_slot(t: TableData, slot: TableData, cap: int) -> TableData:
-    """Device-side pack of an (already compacted) output table into its
-    donated transfer slot: identical math to ``_slice_table``, but the
-    ``slot`` argument's buffers are DONATED, so XLA writes the result
-    into the resident transfer-ready memory instead of allocating — the
-    background D2H stream then always reads from one of two stable
-    buffer sets per output. The caller guarantees the donated slot's
-    previous transfer has landed (PendingBatch._landed)."""
+def _pack_impl(t: TableData, slot: TableData, cap: int) -> TableData:
     del slot  # consumed via donation: provides the output buffers
     return TableData(
         {c: v[:cap] if v.shape[:1] == t.valid.shape else v
@@ -1457,21 +1831,87 @@ def _pack_slot(t: TableData, slot: TableData, cap: int) -> TableData:
     )
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
-def _slice_table(t: TableData, cap: int) -> TableData:
-    """Device-side shrink of an (already compacted) output table to its
-    sized transfer capacity — the D2H copy then moves ``cap`` rows
-    instead of the full padded capacity. One compiled slice per
-    (table layout, cap) pair; caps are power-of-two buckets, so the
-    trace count stays logarithmic. The full-capacity source is
-    deliberately NOT donated into the slice: the two-phase overflow
-    fallback re-fetches it when ``counts_vec`` reveals the sized cap
-    undershot."""
+def _slice_impl(t: TableData, cap: int) -> TableData:
     return TableData(
         {c: v[:cap] if v.shape[:1] == t.valid.shape else v
          for c, v in t.cols.items()},
         t.valid[:cap],
     )
+
+
+# per-capacity-bucket jit cache of the transfer helpers: one jitted
+# closure per (helper kind, cap), LRU-evicted above the conf'd cap so
+# a wandering EWMA (or many outputs x buckets) can never grow the
+# cache — and its compiled executables — forever. Evictions are
+# counted and drained into Compile_JitCacheEvict_Count at collect.
+_HELPER_JIT_LOCK = threading.Lock()
+_HELPER_JITS: "OrderedDict[Tuple[str, int], object]" = OrderedDict()
+_jit_cache_cap = DEFAULT_JIT_CACHE_CAP
+_jit_cache_evictions = 0
+
+
+def set_jit_cache_cap(cap: int) -> None:
+    global _jit_cache_cap
+    _jit_cache_cap = max(1, int(cap))
+
+
+def drain_jit_evictions() -> int:
+    """Helper-jit LRU evictions since the last drain (process-wide)."""
+    global _jit_cache_evictions
+    with _HELPER_JIT_LOCK:
+        n = _jit_cache_evictions
+        _jit_cache_evictions = 0
+        return n
+
+
+def helper_jit_cache_size() -> int:
+    with _HELPER_JIT_LOCK:
+        return len(_HELPER_JITS)
+
+
+def _helper_jit(kind: str, cap: int):
+    global _jit_cache_evictions
+    key = (kind, cap)
+    with _HELPER_JIT_LOCK:
+        fn = _HELPER_JITS.get(key)
+        if fn is not None:
+            _HELPER_JITS.move_to_end(key)
+            return fn
+        if kind == "slice":
+            fn = jax.jit(functools.partial(_slice_impl, cap=cap))
+        else:
+            fn = jax.jit(
+                functools.partial(_pack_impl, cap=cap), donate_argnums=(1,)
+            )
+        _HELPER_JITS[key] = fn
+        while len(_HELPER_JITS) > _jit_cache_cap:
+            _HELPER_JITS.popitem(last=False)
+            _jit_cache_evictions += 1
+        return fn
+
+
+def _pack_slot(t: TableData, slot: TableData, cap: int) -> TableData:
+    """Device-side pack of an (already compacted) output table into its
+    donated transfer slot: identical math to ``_slice_table``, but the
+    ``slot`` argument's buffers are DONATED, so XLA writes the result
+    into the resident transfer-ready memory instead of allocating — the
+    background D2H stream then always reads from one of two stable
+    buffer sets per output. The caller guarantees the donated slot's
+    previous transfer has landed (PendingBatch._landed)."""
+    return _helper_jit("pack", cap)(t, slot)
+
+
+def _slice_table(t: TableData, cap: int) -> TableData:
+    """Device-side shrink of an (already compacted) output table to its
+    sized transfer capacity — the D2H copy then moves ``cap`` rows
+    instead of the full padded capacity. One compiled slice per
+    (table layout, cap) pair; caps are power-of-two buckets
+    (``transfer_buckets``), so the trace count stays logarithmic AND
+    bounded (LRU above the jit-cache cap). The full-capacity source is
+    deliberately NOT donated into the slice: the two-phase overflow
+    fallback re-fetches it when ``counts_vec`` reveals the sized cap
+    undershot."""
+    return _helper_jit("slice", cap)(t)
 
 
 # does this array type support copy_to_host_async? Probed ONCE per
@@ -1832,6 +2272,26 @@ class PendingBatch:
         retraces = proc.drain_retraces()
         if retraces:
             metrics["Retrace_Count"] = float(retraces)
+        # warm-start promise check (the DX604 input): the AOT warm left
+        # the step's jit cache at _warm_step_mark; growth past it means
+        # a dispatch compiled even though a warm start was promised
+        if proc._aot_warmed and proc._warm_step_mark is not None:
+            cur = proc._step_cache_size()
+            if cur is not None and cur > proc._warm_step_mark:
+                proc.compile_stats["WarmMiss_Count"] = (
+                    proc.compile_stats.get("WarmMiss_Count", 0.0)
+                    + float(cur - proc._warm_step_mark)
+                )
+                proc._warm_step_mark = cur
+        # transfer-helper jit LRU evictions + one-shot compile stats
+        # (cold-start ms, persistent-cache hits/misses, warm misses)
+        evictions = drain_jit_evictions()
+        if evictions:
+            metrics["Compile_JitCacheEvict_Count"] = float(evictions)
+        if proc.compile_stats:
+            for k, v in proc.compile_stats.items():
+                metrics[f"Compile_{k}"] = float(v)
+            proc.compile_stats.clear()
         # sized-transfer accounting: bytes actually moved D2H for this
         # batch and the valid/transferred row ratio (1.0 = wire minimum)
         if names:

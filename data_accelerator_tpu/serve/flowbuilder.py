@@ -73,6 +73,7 @@ DEFAULT_JOB_COMMON_TOKENS: Dict[str, str] = {
     "jobBatchCapacity": "_S_{guiJobBatchCapacity}",
     "jobPipelineDepth": "_S_{guiJobPipelineDepth}",
     "jobObservabilityPort": "_S_{guiJobObservabilityPort}",
+    "jobCompileJitCacheCap": "_S_{guiJobCompileJitCacheCap}",
     "processedSchemaPath": "_S_{processedSchemaPath}",
 }
 

@@ -109,6 +109,18 @@ class FlowOperation:
 
         return analyze_flow_udfs(flow)
 
+    def validate_flow_compile(self, flow: dict, manifest: Optional[dict] = None):
+        """The compile tier of ``flow/validate`` (``compile: true``):
+        every jit entry point the flow will ever dispatch is enumerated
+        and lowered over ``jax.eval_shape`` avals — the DX6xx
+        finiteness/stability lints plus the AOT compile manifest.
+        ``manifest`` (body ``compileManifest``) additionally checks a
+        previously emitted manifest for drift (DX602/DX603). Same
+        implementation as the CLI's ``--compile``; no device executes."""
+        from ..analysis import analyze_flow_compile
+
+        return analyze_flow_compile(flow, manifest=manifest)
+
     def validate_flow_fleet(self, flow: dict, spec: Optional[dict] = None):
         """The fleet tier of ``flow/validate`` (``fleet: true``): the
         candidate flow is analyzed AS A SET with every currently

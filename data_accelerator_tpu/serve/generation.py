@@ -90,6 +90,7 @@ class RuntimeConfigGeneration:
             self._s550_batch,
             self._s600_job_configs,
             self._s620_conformance,
+            self._s630_compile,
             self._s650_flatten,
             self._s700_write_files,
             self._s800_jobs,
@@ -198,6 +199,12 @@ class RuntimeConfigGeneration:
             # pin the same port
             "guiJobObservabilityPort": str(
                 jobconf.get("jobObservabilityPort") or ""
+            ),
+            # bound on the transfer-helper jit caches; empty = engine
+            # default (runtime/processor.py DEFAULT_JIT_CACHE_CAP, the
+            # same constant the DX601 compile-surface lint uses)
+            "guiJobCompileJitCacheCap": str(
+                jobconf.get("jobCompileJitCacheCap") or ""
             ),
             "processedSchemaPath": os.path.join(
                 self.runtime.resolve(flow_dir), "processedschema.json"
@@ -512,6 +519,72 @@ class RuntimeConfigGeneration:
             default_rules(doc.get("name")), separators=(",", ":")
         )
 
+    def _s630_compile(self, ctx) -> None:
+        """Emit the flow's AOT **compile manifest** as a deployment
+        artifact and wire the persistent compilation cache — the
+        reference compiled Flow JSON into a deployable job artifact
+        ahead of time (SURVEY §1 L3, DataX.Config -> flat .conf ->
+        spark-submit); ours additionally ships the *compiled
+        executables' coordinates*: the compile-surface analyzer
+        (``analysis/compilecheck.py``) proves the flow's jit entry set
+        finite, the manifest lands beside the conf
+        (``<flow>/compile.manifest.json``), and the conf points at it
+        (``datax.job.process.compile.manifest``) so ``FlowProcessor``
+        AOT-warms every entry at init instead of first dispatch.
+
+        The cache conf rides along: ``compile.cachedir`` under the
+        flow's runtime folder (restarts deserialize instead of
+        recompiling), and — when runtime storage is the shared object
+        store — ``compile.cacheurl`` (an ``objstore://`` prefix) so
+        preemption-recovered and scaled-out replicas pull compiles
+        their peers already paid for.
+
+        Fail-open like S620: an analyzer error must not block
+        deployment — the job simply cold-starts like every job did
+        before this layer existed. Opt out with designer jobconfig
+        ``jobCompileManifest: "false"``. Skipped for multi-chip jobs
+        (mesh shardings change the lowering; the manifest is a
+        single-chip artifact for now)."""
+        doc = ctx["doc"]
+        jobconf = (doc["gui"].get("process") or {}).get("jobconfig") or {}
+        ctx["compile_manifest_path"] = None
+        chips = str(
+            jobconf.get("jobNumChips")
+            or jobconf.get("jobNumExecutors") or "1"
+        )
+        if (
+            str(jobconf.get("jobCompileManifest", "")).lower() != "false"
+            and chips in ("", "1")
+        ):
+            try:
+                from ..analysis import analyze_flow_compile
+
+                report = analyze_flow_compile(doc)
+                if report.manifest and report.manifest.get("entries"):
+                    mpath = os.path.join(
+                        ctx["flow_dir"], "compile.manifest.json"
+                    )
+                    ctx["result"].files[mpath] = json.dumps(
+                        report.manifest, separators=(",", ":")
+                    )
+                    ctx["compile_manifest_path"] = (
+                        self.runtime.stored_path(mpath)
+                    )
+            except Exception as e:  # noqa: BLE001 — AOT is an optimization
+                logger.warning(
+                    "compile manifest generation failed for %s: %s",
+                    doc.get("name"), e,
+                )
+        ctx["compile_cache_dir"] = os.path.join(
+            self.runtime.resolve(ctx["flow_dir"]), "compilecache"
+        )
+        ctx["compile_cache_url"] = None
+        client = getattr(self.runtime, "client", None)
+        if client is not None and hasattr(client, "url_for"):
+            ctx["compile_cache_url"] = client.url_for(
+                f"{ctx['flow_dir']}/compilecache".replace(os.sep, "/")
+            )
+
     def _s650_flatten(self, ctx) -> None:
         """Flatten each resolved job config JSON to flat conf text
         (S650 ConfigFlattener.Flatten)."""
@@ -543,6 +616,18 @@ class RuntimeConfigGeneration:
             if ctx.get("alert_rules_json"):
                 extra["datax.job.process.alerts.rules"] = (
                     ctx["alert_rules_json"])
+            if ctx.get("compile_manifest_path"):
+                extra["datax.job.process.compile.manifest"] = (
+                    ctx["compile_manifest_path"])
+            if ctx.get("compile_cache_dir"):
+                extra["datax.job.process.compile.cachedir"] = (
+                    ctx["compile_cache_dir"])
+            if ctx.get("compile_cache_url"):
+                extra["datax.job.process.compile.cacheurl"] = (
+                    ctx["compile_cache_url"])
+            if jt.get("jobCompileJitCacheCap"):
+                extra["datax.job.process.compile.jitcachecap"] = str(
+                    jt.get("jobCompileJitCacheCap"))
             for b_i, b in enumerate(ctx.get("batch_inputs") or []):
                 ns = f"datax.job.input.batch.blob.{b_i}"
                 for k, v in b.items():

@@ -27,6 +27,7 @@ from __future__ import annotations
 import contextlib
 import json
 import logging
+import os
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -71,8 +72,18 @@ class DataXApi:
         # the batch spans it caused. None = tracing off (default).
         self.tracer = tracer
         self.flow_ops = flow_ops
+        # kernel pool shares one persistent compile cache under the
+        # runtime root: repeated kernel creates (and restarts of the
+        # whole control plane) deserialize query compiles instead of
+        # re-tracing them — the warm-LiveQuery-pool half of the AOT
+        # compile path (runtime/processor.py process.compile.*)
         self.kernels = kernels or KernelService(
-            runtime_storage=flow_ops.runtime
+            runtime_storage=flow_ops.runtime,
+            compile_conf={
+                "datax.job.process.compile.cachedir": os.path.join(
+                    flow_ops.runtime.resolve("livequery"), "compilecache"
+                ),
+            },
         )
         self.schema_inference = SchemaInferenceManager(flow_ops.runtime)
         self.analyzer = SqlAnalyzer()
@@ -212,7 +223,14 @@ class DataXApi:
         is analyzed against every currently registered flow — DX4xx
         capacity/interference lints merged into the diagnostics plus a
         ``fleet`` placement plan (chip -> flows -> packed HBM/headroom);
-        optional ``"fleetSpec": {...}`` overrides the default fleet."""
+        optional ``"fleetSpec": {...}`` overrides the default fleet.
+        ``"compile": true`` adds the compile-surface tier (the CLI's
+        ``--compile``): DX6xx finiteness/stability lints merged into
+        the diagnostics plus a ``compile`` section carrying the AOT
+        compile manifest; optional ``"compileManifest": {...}`` checks
+        a previously emitted manifest for drift (DX602/DX603).
+        ``"all": true`` runs every tier in one call — one merged
+        report, one ``schemaVersion``, the CI single-invocation path."""
         flow = body.get("flow") or body.get("gui")
         if flow is None and (body.get("flowName") or body.get("name")) \
                 and not body.get("process") and not body.get("input"):
@@ -222,28 +240,39 @@ class DataXApi:
         if flow is None:
             flow = body
         report = self.flow_ops.validate_flow(flow)
-        if not body.get("device") and not body.get("udfs") \
-                and not body.get("fleet"):
+        all_tiers = bool(body.get("all"))
+        want_device = all_tiers or body.get("device")
+        want_udfs = all_tiers or body.get("udfs")
+        want_fleet = all_tiers or body.get("fleet")
+        want_compile = all_tiers or body.get("compile")
+        if not (want_device or want_udfs or want_fleet or want_compile):
             return report.to_dict()
         from ..analysis import combined_report_dict
 
         device = None
-        if body.get("device"):
+        if want_device:
             chips = body.get("chips")
             device = self.flow_ops.validate_flow_device(
                 flow, chips=int(chips) if chips else None
             )
         udfs = (
-            self.flow_ops.validate_flow_udfs(flow)
-            if body.get("udfs") else None
+            self.flow_ops.validate_flow_udfs(flow) if want_udfs else None
         )
         fleet = (
             self.flow_ops.validate_flow_fleet(
                 flow, spec=body.get("fleetSpec")
             )
-            if body.get("fleet") else None
+            if want_fleet else None
         )
-        return combined_report_dict(report, device, udfs, fleet)
+        comp = (
+            self.flow_ops.validate_flow_compile(
+                flow, manifest=body.get("compileManifest")
+            )
+            if want_compile else None
+        )
+        return combined_report_dict(
+            report, device, udfs, fleet, compile_surface=comp
+        )
 
     def _flow_generate(self, body, query):
         res = self.flow_ops.generate_configs(self._flow_name(body, query))

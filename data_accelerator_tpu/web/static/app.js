@@ -199,6 +199,21 @@ route("#/flow/", async (view, hash) => {
         (f.analyzed && f.analyzed.length ? ` (${f.analyzed.join(",")})` : "")
       ).join(" · "));
   };
+  const renderCompileSurface = (c) => {
+    // compile tier (flow/validate compile: true): the enumerated jit
+    // entry points + AOT manifest summary — "stable" means the flow
+    // ships precompiled and restarts warm-start in sub-second
+    if (!c || !c.entries) return null;
+    return h("div", { class: "muted" },
+      `compile surface: ${c.entries} entries (1 step + ` +
+      `${c.helperEntries} transfer-helper over ` +
+      `${(c.buckets || []).length} bucket(s)) — ` +
+      (c.stable ? "stable (AOT manifest covers every dispatch; " +
+                  "warm starts skip first-dispatch compiles)"
+                : "OPEN (manifest covers the initial surface only; " +
+                  "runtime re-traces surface as Retrace_Count)") +
+      `, jit-cache cap ${c.jitCacheCap}`);
+  };
   const renderDiags = (r) => {
     diagBox.replaceChildren(
       h("div", { class: "muted" },
@@ -210,13 +225,16 @@ route("#/flow/", async (view, hash) => {
         h("span", {}, d.message),
         d.span && d.span.line ? h("span", { class: "muted" }, ` line ${d.span.line}`) : null)),
       renderUdfSummary(r.udfs),
+      renderCompileSurface(r.compile),
       renderCostTable(r.device),
       renderPlacement(r.fleet));
   };
   const validate = async () => {
     await save();
+    // all: true = every analysis tier in one call (semantic + device +
+    // udfs + fleet + compile), one merged diagnostics list
     const r = await api("POST", "/api/flow/flow/validate",
-      { flow: gui, device: true, udfs: true, fleet: true });
+      { flow: gui, all: true });
     renderDiags(r);
     toast(r.ok ? "flow is clean" : `${r.errorCount} error(s) found`, r.ok);
     return r;

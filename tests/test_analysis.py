@@ -150,6 +150,7 @@ def test_golden_udf_diagnostic(fixture, code, severity):
 
 
 def test_every_registered_code_has_a_golden_fixture():
+    from test_compilecheck import COMPILE_GOLDEN
     from test_fleetcheck import FLEET_GOLDEN
 
     assert (
@@ -157,6 +158,7 @@ def test_every_registered_code_has_a_golden_fixture():
         | {g[1] for g in DEVICE_GOLDEN}
         | {g[1] for g in UDF_GOLDEN}
         | {g[2] for g in FLEET_GOLDEN}
+        | {g[1] for g in COMPILE_GOLDEN}
     ) == set(CODES)
 
 

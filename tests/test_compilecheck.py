@@ -1,0 +1,593 @@
+"""Compile-surface analyzer (DX6xx) + AOT manifest tests.
+
+- golden fixtures per DX6xx code under tests/data/flows/ (DX602/DX603
+  are comparison codes: their fixtures are clean flows the tests tamper
+  a freshly derived manifest against)
+- manifest == lowering byte-exactness (the ``test_deviceplan.py``
+  pattern): the statically emitted manifest equals the entries a REAL
+  ``FlowProcessor`` derives from its live device state — entry set,
+  aval signatures, donation patterns AND StableHLO lowering digests
+- warm-vs-cold ``FlowProcessor`` init through the FULL generation path
+  (designer gui → S100–S900 → flat conf → processor): a warm start
+  performs zero first-dispatch step compiles; a post-warm signature the
+  manifest never promised fires the DX604 runtime counterpart
+  (``Compile_WarmMiss_Count``)
+- persistent compilation cache: misses on first start, hits on
+  restart, shared through a real ``objstore://`` store
+- LRU-bounded transfer-helper jit caches: cap honored, evictions
+  counted, ONE constant shared with the DX601 lint
+- CLI ``--compile``/``--all`` + REST ``"compile"``/``"all"`` parity
+- tier-1 self-lint: every shipped scenario/baseline flow passes
+  ``--compile`` clean with a stable, drift-free manifest
+"""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from data_accelerator_tpu.analysis import (
+    CODES,
+    SEV_ERROR,
+    SEV_WARNING,
+    analyze_flow,
+    analyze_flow_compile,
+    analyze_processor_compile,
+)
+from data_accelerator_tpu.analysis.compilecheck import check_manifest
+from data_accelerator_tpu.core.config import SettingDictionary
+from data_accelerator_tpu.runtime.processor import (
+    DEFAULT_JIT_CACHE_CAP,
+    FlowProcessor,
+    drain_jit_evictions,
+    helper_jit_cache_size,
+    pack_raw,
+    set_jit_cache_cap,
+    _slice_table,
+)
+from data_accelerator_tpu.serve.scenarios import shipped_flow_guis
+
+FLOWS_DIR = os.path.join(os.path.dirname(__file__), "data", "flows")
+
+
+def load_flow(name: str) -> dict:
+    with open(os.path.join(FLOWS_DIR, name + ".json")) as f:
+        return json.load(f)
+
+
+def clean_flow_paths():
+    return sorted(
+        os.path.join(FLOWS_DIR, f)
+        for f in os.listdir(FLOWS_DIR)
+        if f.startswith("clean_") and f.endswith(".json")
+    )
+
+
+def conf_for_gui(gui: dict, extra: dict = None) -> SettingDictionary:
+    """A runnable flat conf equivalent to a single-source fixture gui —
+    the same lowering inputs config generation would produce, so the
+    static (gui) and runtime (conf) analysis paths must agree."""
+    from data_accelerator_tpu.compile.codegen import CodegenEngine
+    from data_accelerator_tpu.serve.flowbuilder import RuleDefinitionGenerator
+
+    proc = gui["process"]
+    rc = CodegenEngine().generate_code(
+        "\n".join(proc["queries"]),
+        RuleDefinitionGenerator().generate(gui.get("rules") or [],
+                                           gui["name"]),
+        gui["name"],
+        windowable_tables={"DataXProcessedInput"},
+    )
+    conf = {
+        "datax.job.name": gui["name"],
+        "datax.job.input.default.blobschemafile":
+            gui["input"]["properties"]["inputSchemaFile"],
+        "datax.job.input.default.streaming.intervalinseconds": "1",
+        "datax.job.process.timestampcolumn": proc.get("timestampColumn", ""),
+        "datax.job.process.watermark": proc.get("watermark", "0 second"),
+        "datax.job.process.projection":
+            gui["input"]["properties"].get("normalizationSnippet", "Raw.*"),
+        "datax.job.process.transform": rc.code,
+        "datax.job.process.batchcapacity": str(
+            (proc.get("jobconfig") or {}).get("jobBatchCapacity") or 65536
+        ),
+    }
+    for wname, dur in rc.time_windows.items():
+        conf[f"datax.job.process.timewindow.{wname}.windowduration"] = dur
+    for tables, _sink in rc.outputs:
+        for t in tables.split(","):
+            conf[f"datax.job.output.{t.strip()}.metric"] = "enabled"
+    conf.update(extra or {})
+    return SettingDictionary(conf)
+
+
+# ---------------------------------------------------------------------------
+# golden fixtures (imported by test_analysis's registry-coverage test)
+# ---------------------------------------------------------------------------
+COMPILE_GOLDEN = [
+    ("dx600_open_surface", "DX600", SEV_WARNING),
+    ("dx601_bucket_blowup", "DX601", SEV_WARNING),
+    ("dx602_manifest_donation", "DX602", SEV_ERROR),
+    ("dx603_manifest_drift", "DX603", SEV_ERROR),
+    ("dx690_lowering_failure", "DX690", SEV_ERROR),
+    ("dx691_unavailable", "DX691", SEV_WARNING),
+]
+
+# codes that need a shipped manifest to compare against — their
+# fixtures are clean flows; the golden test tampers the manifest
+_COMPARISON_CODES = {"DX602", "DX603"}
+
+
+@pytest.mark.parametrize("fixture,code,severity", COMPILE_GOLDEN,
+                         ids=[g[0] for g in COMPILE_GOLDEN])
+def test_golden_compile_diagnostic(fixture, code, severity):
+    flow = load_flow(fixture)
+    # compile-tier-only findings: the semantic tier stays clean
+    assert analyze_flow(flow).errors == []
+    if code in _COMPARISON_CODES:
+        fresh = analyze_flow_compile(flow)
+        assert fresh.ok and fresh.manifest is not None
+        tampered = copy.deepcopy(fresh.manifest)
+        if code == "DX602":
+            # donation pattern lies: step claims nothing donated
+            tampered["entries"][0]["donate"] = []
+        else:
+            # aval drift: one leaf shape altered
+            tampered["entries"][0]["avals"]["leaves"][0][0][0] += 1
+        report = analyze_flow_compile(flow, manifest=tampered)
+    else:
+        report = analyze_flow_compile(flow)
+    hits = [d for d in report.diagnostics if d.code == code]
+    assert hits, f"expected {code}, got {report.codes()}"
+    assert hits[0].severity == severity
+    assert hits[0].severity == CODES[code][0]
+    assert report.ok == (severity != SEV_ERROR)
+
+
+def test_golden_compile_clean_twins():
+    """Each bad fixture's minimal fix analyzes clean/stable again."""
+    # DX600's twin: the same flow without the interval-refreshing UDF
+    flow = load_flow("dx600_open_surface")
+    twin = copy.deepcopy(flow)
+    twin["process"]["functions"] = []
+    twin["process"]["queries"] = [
+        "--DataXQuery--\nScaled = SELECT deviceId, temperature AS t2 "
+        "FROM DataXProcessedInput;\nOUTPUT Scaled TO Metrics;"
+    ]
+    report = analyze_flow_compile(twin)
+    assert report.diagnostics == [] and report.stable
+    # DX601's twin: the same flow at a sane batch capacity
+    flow = load_flow("dx601_bucket_blowup")
+    twin = copy.deepcopy(flow)
+    twin["process"]["jobconfig"]["jobBatchCapacity"] = "65536"
+    report = analyze_flow_compile(twin)
+    assert "DX601" not in report.codes()
+    # ...and raising the conf'd cap clears DX601 on the bad fixture
+    # (the lint honors the SAME knob the runtime bound reads)
+    report = analyze_flow_compile(flow, jit_cache_cap=64)
+    assert "DX601" not in report.codes()
+
+
+def test_dx600_message_names_the_refresh_udf():
+    report = analyze_flow_compile(load_flow("dx600_open_surface"))
+    hits = [d for d in report.diagnostics if d.code == "DX600"]
+    assert hits and "scaleby" in hits[0].message
+    assert not report.stable
+    assert report.manifest is not None  # initial surface still ships
+    assert report.manifest["stable"] is False
+
+
+# ---------------------------------------------------------------------------
+# manifest == lowering byte-exactness (the DX603 contract)
+# ---------------------------------------------------------------------------
+def test_manifest_matches_runtime_lowering_byte_exact():
+    """The statically emitted manifest equals what a real FlowProcessor
+    derives from its live device state — entries, avals, donation AND
+    lowering digests — because both sides share build_step_fn and
+    compile_entries_from_avals. Asserted on the DX603 fixture flow (a
+    windowed group-by, i.e. rings + helpers in play)."""
+    flow = load_flow("dx603_manifest_drift")
+    static = analyze_flow_compile(flow)
+    assert static.ok and static.stable
+
+    proc = FlowProcessor(conf_for_gui(flow))
+    runtime = analyze_processor_compile(proc)
+    s = {e["entry"]: e for e in static.entries}
+    r = {e["entry"]: e for e in runtime.entries}
+    assert set(s) == set(r)
+    for name in s:
+        for field in ("donate", "static", "avals", "loweringDigest"):
+            assert s[name][field] == r[name][field], (name, field)
+
+    # the static manifest checks drift-free against the runtime surface
+    assert analyze_processor_compile(proc, manifest=static.manifest).ok
+
+    # ...and a capacity change IS drift (DX603), caught both ways
+    changed = copy.deepcopy(flow)
+    changed["process"]["jobconfig"]["jobBatchCapacity"] = "8192"
+    drifted = analyze_flow_compile(changed, manifest=static.manifest)
+    assert "DX603" in drifted.codes() and not drifted.ok
+    diags = []
+    check_manifest(static.manifest, analyze_flow_compile(changed).entries,
+                   diags)
+    assert any(d.code == "DX603" for d in diags)
+
+
+def test_step_entry_records_ring_donation_contract():
+    from data_accelerator_tpu.runtime.processor import STEP_DONATE_ARGNUMS
+
+    report = analyze_flow_compile(load_flow("dx603_manifest_drift"))
+    step = [e for e in report.entries if e["entry"] == "step"][0]
+    assert step["donate"] == list(STEP_DONATE_ARGNUMS)
+    packs = [e for e in report.entries if e["entry"].startswith("pack:")]
+    assert packs and all(e["donate"] == [1] for e in packs)
+    slices = [e for e in report.entries if e["entry"].startswith("slice:")]
+    assert slices and all(e["donate"] == [] for e in slices)
+    # every entry carries the deployable coordinates
+    for e in report.entries:
+        assert e["cacheKey"] and e["loweringDigest"] and e["avals"]["leaves"]
+
+
+# ---------------------------------------------------------------------------
+# tier-1 self-lint: shipped flows must ship precompilable
+# ---------------------------------------------------------------------------
+def test_compile_self_lint_shipped_and_baseline_flows():
+    """Every shipped scenario flow AND every clean baseline-mirror
+    fixture passes ``--compile`` with zero error diagnostics and emits
+    a manifest with at least the step entry."""
+    flows = [(g.get("name"), g) for g in shipped_flow_guis()]
+    for path in clean_flow_paths():
+        with open(path) as f:
+            flows.append((os.path.basename(path), json.load(f)))
+    assert len(flows) >= 6
+    for name, flow in flows:
+        report = analyze_flow_compile(flow)
+        assert report.errors == [], (
+            f"{name}: {[d.render() for d in report.errors]}"
+        )
+        assert report.manifest is not None, name
+        entries = [e["entry"] for e in report.manifest["entries"]]
+        assert "step" in entries, name
+
+
+# ---------------------------------------------------------------------------
+# runtime half: warm-vs-cold init through the FULL generation path
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def generated_conf(tmp_path):
+    """gui → S100–S900 → flat conf (with the S630 compile block) →
+    parsed SettingDictionary + the raw text."""
+    from test_serve_generation import make_gui
+
+    from data_accelerator_tpu.core.config import parse_conf_lines
+    from data_accelerator_tpu.serve.generation import RuntimeConfigGeneration
+    from data_accelerator_tpu.serve.storage import (
+        LocalDesignTimeStorage,
+        LocalRuntimeStorage,
+    )
+
+    design = LocalDesignTimeStorage(str(tmp_path / "design"))
+    runtime = LocalRuntimeStorage(str(tmp_path / "runtime"))
+    gen = RuntimeConfigGeneration(design, runtime)
+    gui = make_gui("CompileWarm")
+    design.save({"name": gui["name"], "gui": gui})
+    res = gen.generate(gui["name"])
+    assert res.ok, res.errors
+    text = open(res.conf_paths[0]).read()
+    return SettingDictionary(parse_conf_lines(text.splitlines())), text
+
+
+def test_generation_embeds_manifest_and_cache_conf(generated_conf):
+    conf, text = generated_conf
+    mpath = conf.get("datax.job.process.compile.manifest")
+    assert mpath and os.path.exists(mpath)
+    manifest = json.loads(open(mpath).read())
+    assert manifest["flow"] == "CompileWarm"
+    assert [e["entry"] for e in manifest["entries"]].count("step") == 1
+    assert "datax.job.process.compile.cachedir=" in text
+
+
+def test_warm_init_performs_no_first_dispatch_compile(generated_conf):
+    """The acceptance bit: with the generated manifest present, init
+    AOT-compiles everything; the first REAL dispatch adds no step
+    trace, no warm-miss, no manifest drift. Cold (manifest stripped),
+    the same conf pays its first step compile at dispatch."""
+    conf, _text = generated_conf
+    rows = [{
+        "deviceDetails": {"deviceId": 1, "deviceType": "DoorLock",
+                          "homeId": 150, "status": 0,
+                          "temperature": 20.0},
+        "eventTimeStamp": 1_700_000_000_000,
+    }]
+
+    cold_dict = {
+        k: v for k, v in conf.dict.items()
+        if not k.startswith("datax.job.process.compile.")
+    }
+    cold = FlowProcessor(SettingDictionary(cold_dict))
+    assert not cold._aot_warmed and cold._step_cache_size() == 0
+    cold.process_batch(
+        cold.encode_rows(rows, 1_700_000_000_000),
+        batch_time_ms=1_700_000_000_000,
+    )
+    assert cold._step_cache_size() == 1  # first dispatch compiled
+
+    warm = FlowProcessor(SettingDictionary(dict(conf.dict)))
+    try:
+        assert warm._aot_warmed and warm.compile_manifest is not None
+        mark = warm._warm_step_mark
+        assert mark and mark >= 1  # init compiled the step
+        _d, m = warm.process_batch(
+            warm.encode_rows(rows, 1_700_000_000_000),
+            batch_time_ms=1_700_000_000_000,
+        )
+        assert warm._step_cache_size() == mark  # zero dispatch compiles
+        assert "Compile_WarmMiss_Count" not in m
+        assert "Compile_ManifestDrift_Count" not in m
+        assert m["Compile_ColdStart_Ms"] > 0
+    finally:
+        if warm._compile_cache is not None:
+            warm._compile_cache.disable()
+
+
+def test_warm_miss_fires_dx604_counter(generated_conf):
+    """A post-warm dispatch with a trace signature the manifest never
+    promised (the packed raw form on a local-input flow) compiles at
+    dispatch — the missed warm promise surfaces as
+    Compile_WarmMiss_Count (DX604's runtime face)."""
+    conf, _text = generated_conf
+    warm = FlowProcessor(SettingDictionary(dict(conf.dict)))
+    try:
+        spec = warm.specs[warm.primary]
+        np_cols = {
+            c: np.zeros(
+                spec.capacity,
+                {"double": np.float32, "boolean": np.bool_}.get(t, np.int32),
+            )
+            for c, t in spec.raw_schema.types.items()
+        }
+        packed = pack_raw(np_cols, np.zeros(spec.capacity, np.bool_))
+        _d, m = warm.process_batch(packed, batch_time_ms=1_700_000_000_000)
+        assert m.get("Compile_WarmMiss_Count", 0) >= 1
+    finally:
+        if warm._compile_cache is not None:
+            warm._compile_cache.disable()
+
+
+def test_persistent_cache_hits_across_restarts(generated_conf):
+    """Second init against the same cachedir deserializes instead of
+    compiling: misses on the first start become hits on the restart."""
+    conf, _text = generated_conf
+    rows = [{
+        "deviceDetails": {"deviceId": 1, "deviceType": "Heating",
+                          "homeId": 150, "status": 1,
+                          "temperature": 50.0},
+        "eventTimeStamp": 1_700_000_000_000,
+    }]
+    procs = []
+    try:
+        p1 = FlowProcessor(SettingDictionary(dict(conf.dict)))
+        procs.append(p1)
+        _d, m1 = p1.process_batch(
+            p1.encode_rows(rows, 1_700_000_000_000),
+            batch_time_ms=1_700_000_000_000,
+        )
+        assert m1["Compile_Cache_Miss_Count"] > 0
+        p2 = FlowProcessor(SettingDictionary(dict(conf.dict)))
+        procs.append(p2)
+        _d, m2 = p2.process_batch(
+            p2.encode_rows(rows, 1_700_000_000_000),
+            batch_time_ms=1_700_000_000_000,
+        )
+        assert m2["Compile_Cache_Hit_Count"] >= m1["Compile_Cache_Miss_Count"]
+        assert m2["Compile_Cache_Miss_Count"] == 0
+        assert m2["Compile_ColdStart_Ms"] < m1["Compile_ColdStart_Ms"]
+    finally:
+        for p in reversed(procs):
+            if p._compile_cache is not None:
+                p._compile_cache.disable()
+
+
+def test_compile_cache_routes_through_objstore(tmp_path):
+    """cacheurl = objstore:// prefix: the first processor pushes its
+    compiles to the shared store; a replica with a DIFFERENT local dir
+    pulls them back (the preemption-recovery / scale-out path)."""
+    from data_accelerator_tpu.serve.objectstore import (
+        ObjectStoreClient,
+        ObjectStoreServer,
+    )
+
+    store = ObjectStoreServer(port=0, root=str(tmp_path / "store")).start()
+    procs = []
+    try:
+        client = ObjectStoreClient(store.endpoint)
+        url = client.url_for("flows/CacheFlow/compilecache")
+        flow = load_flow("dx602_manifest_donation")
+        manifest = analyze_flow_compile(flow, digests=False).manifest
+        extra = {
+            "datax.job.process.compile.manifest": json.dumps(manifest),
+            "datax.job.process.compile.cacheurl": url,
+        }
+        extra_a = dict(extra)
+        extra_a["datax.job.process.compile.cachedir"] = str(tmp_path / "a")
+        p1 = FlowProcessor(conf_for_gui(flow, extra_a))
+        procs.append(p1)
+        assert p1._aot_warmed
+        keys = client.list("flows/CacheFlow/compilecache")
+        assert keys, "warm pushed no cache entries to the store"
+        extra_b = dict(extra)
+        extra_b["datax.job.process.compile.cachedir"] = str(tmp_path / "b")
+        p2 = FlowProcessor(conf_for_gui(flow, extra_b))
+        procs.append(p2)
+        pulled = [
+            f for f in os.listdir(str(tmp_path / "b"))
+            if not f.endswith("-atime")
+        ]
+        assert len(pulled) >= len(keys)
+        assert p2.compile_stats["Cache_Hit_Count"] >= len(keys)
+    finally:
+        for p in reversed(procs):
+            if p._compile_cache is not None:
+                p._compile_cache.disable()
+        store.stop()
+
+
+# ---------------------------------------------------------------------------
+# LRU-bounded transfer-helper jit caches (shared DX601 constant)
+# ---------------------------------------------------------------------------
+def test_helper_jit_cache_lru_bound_and_evictions():
+    from data_accelerator_tpu.compile.planner import TableData
+    import jax.numpy as jnp
+
+    drain_jit_evictions()
+    set_jit_cache_cap(4)
+    try:
+        t = TableData({"x": jnp.zeros((4096,), jnp.int32)},
+                      jnp.zeros((4096,), jnp.bool_))
+        for cap in (8, 16, 32, 64, 128, 256, 512, 1024):
+            _slice_table(t, cap)
+        assert helper_jit_cache_size() <= 4
+        assert drain_jit_evictions() >= 4
+        # LRU: re-slicing a recent cap compiles nothing new
+        _slice_table(t, 1024)
+        assert drain_jit_evictions() == 0
+    finally:
+        set_jit_cache_cap(DEFAULT_JIT_CACHE_CAP)
+
+
+def test_dx601_and_runtime_share_one_constant():
+    """The DX601 lint's default bound IS the runtime's default cap —
+    one constant, imported by both sides."""
+    from data_accelerator_tpu.analysis import compilecheck
+
+    assert compilecheck.DEFAULT_JIT_CACHE_CAP is DEFAULT_JIT_CACHE_CAP
+    report = analyze_flow_compile(load_flow("dx601_bucket_blowup"))
+    helper_keys = {
+        (e["entry"].split(":")[0], e["static"]["cap"])
+        for e in report.entries if e["entry"] != "step"
+    }
+    assert len(helper_keys) > DEFAULT_JIT_CACHE_CAP
+    assert "DX601" in report.codes()
+
+
+def test_jitcachecap_conf_validation():
+    flow = load_flow("dx602_manifest_donation")
+    with pytest.raises(Exception, match="jitcachecap"):
+        FlowProcessor(conf_for_gui(flow, {
+            "datax.job.process.compile.jitcachecap": "0",
+        }))
+
+
+# ---------------------------------------------------------------------------
+# CLI + REST surfaces
+# ---------------------------------------------------------------------------
+def _run_cli(args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.setdefault("PYTHONPATH", os.path.dirname(os.path.dirname(__file__)))
+    return subprocess.run(
+        [sys.executable, "-m", "data_accelerator_tpu.analysis", *args],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(__file__)),
+    )
+
+
+def test_cli_compile_zero_exit_on_clean_config():
+    path = os.path.join(FLOWS_DIR, "dx603_manifest_drift.json")
+    r = _run_cli(["--compile", path])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "compile surface:" in r.stdout and "stable" in r.stdout
+
+
+def test_cli_compile_nonzero_on_lowering_error():
+    path = os.path.join(FLOWS_DIR, "dx690_lowering_failure.json")
+    r = _run_cli(["--compile", path])
+    assert r.returncode == 1
+    assert "DX690" in r.stdout
+
+
+def test_cli_compile_manifest_roundtrip(tmp_path):
+    """--manifest-out writes the artifact; --manifest= checks it
+    drift-free (exit 0) and a tampered copy drifts (exit 1, DX602)."""
+    path = os.path.join(FLOWS_DIR, "dx602_manifest_donation.json")
+    out = str(tmp_path / "m.json")
+    assert _run_cli(["--compile", f"--manifest-out={out}", path]).returncode == 0
+    manifest = json.loads(open(out).read())
+    assert manifest["manifestVersion"] >= 1
+    assert _run_cli(["--compile", f"--manifest={out}", path]).returncode == 0
+    manifest["entries"][0]["donate"] = []
+    bad = str(tmp_path / "bad.json")
+    json.dump(manifest, open(bad, "w"))
+    r = _run_cli(["--compile", f"--manifest={bad}", path])
+    assert r.returncode == 1 and "DX602" in r.stdout
+
+
+def test_cli_all_runs_every_tier_merged():
+    path = os.path.join(FLOWS_DIR, "dx603_manifest_drift.json")
+    r = _run_cli(["--all", "--json", path])
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = json.loads(r.stdout)
+    # fleet wraps per-file reports; one schemaVersion at top level
+    assert out["schemaVersion"] >= 1
+    f = out["files"][0]
+    assert {"device", "udfs", "compile", "diagnostics"} <= set(f)
+    assert f["compile"]["entries"] == len(f["compile"]["manifest"]["entries"])
+
+
+def test_cli_unknown_flag_still_rejected():
+    path = os.path.join(FLOWS_DIR, "dx603_manifest_drift.json")
+    assert _run_cli(["--compiel", path]).returncode == 2
+
+
+@pytest.fixture
+def flow_ops(tmp_path):
+    from test_serve_jobs import FakeJobClient
+
+    from data_accelerator_tpu.serve.flowservice import FlowOperation
+    from data_accelerator_tpu.serve.storage import (
+        LocalDesignTimeStorage,
+        LocalRuntimeStorage,
+    )
+
+    return FlowOperation(
+        LocalDesignTimeStorage(str(tmp_path / "design")),
+        LocalRuntimeStorage(str(tmp_path / "runtime")),
+        job_client=FakeJobClient(),
+    )
+
+
+def test_validate_endpoint_compile_and_all(flow_ops):
+    from data_accelerator_tpu.serve.restapi import DataXApi
+
+    api = DataXApi(flow_ops)
+    flow = load_flow("dx603_manifest_drift")
+    status, out = api.dispatch(
+        "POST", "api/flow/validate", body={"flow": flow, "compile": True}
+    )
+    assert status == 200
+    r = out["result"]
+    assert r["ok"] and r["compile"]["stable"]
+    # endpoint == CLI: same manifest for the same flow
+    cli = analyze_flow_compile(flow)
+    assert r["compile"]["manifest"]["entries"] == [
+        e for e in cli.manifest["entries"]
+    ]
+    # a tampered shipped manifest reaches DX603 through the endpoint
+    bad = copy.deepcopy(cli.manifest)
+    bad["entries"][1]["avals"]["leaves"][0][0][0] += 1
+    status, out = api.dispatch(
+        "POST", "api/flow/validate",
+        body={"flow": flow, "compile": True, "compileManifest": bad},
+    )
+    assert status == 200 and not out["result"]["ok"]
+    codes = {d["code"] for d in out["result"]["diagnostics"]}
+    assert "DX603" in codes
+    # "all": true merges every tier into one report
+    status, out = api.dispatch(
+        "POST", "api/flow/validate", body={"flow": flow, "all": True}
+    )
+    assert status == 200
+    assert {"device", "udfs", "fleet", "compile"} <= set(out["result"])

@@ -189,7 +189,7 @@ class TestDesignerE2E:
         for marker in (
             '"functions"', "AggregateRule", "_S_pivots", "_S_aggs",
             '"scale"', '"schedule"', "azureFunction", "Additional sources",
-            "renderCostTable", "device: true",
+            "renderCostTable", "renderCompileSurface", "all: true",
         ):
             assert marker in js, marker
 

@@ -46,6 +46,19 @@ def test_two_process_ingest_and_cross_host_aggregation():
     try:
         for p in procs:
             out, err = p.communicate(timeout=220)
+            if (
+                p.returncode != 0
+                and b"Multiprocess computations aren't implemented"
+                in err
+            ):
+                # this jaxlib's CPU backend lacks multi-process
+                # collectives — an environment capability, not an
+                # engine regression (see README "Testing"); a real
+                # multi-host slice (or a gloo-enabled jaxlib) runs it
+                pytest.skip(
+                    "CPU backend lacks multi-process collectives "
+                    "(README 'Testing')"
+                )
             assert p.returncode == 0, err.decode()[-2000:]
             line = [
                 ln for ln in out.decode().splitlines() if ln.startswith("{")

@@ -9,6 +9,7 @@ string order, NULLs excluded by predicates).
 
 import datetime as _dt
 import json
+import os
 
 import jax.numpy as jnp
 import numpy as np
@@ -346,7 +347,11 @@ def test_reference_iotsample_script_compiles():
     """The reference's full sample transform (queryupdatesample.sql:
     TIMEWINDOW + refdata join + UDF + accumulator + CreateMetric/
     ProcessRules + CONCAT + hour()/unix_timestamp()) compiles through
-    codegen into a runnable pipeline."""
+    codegen into a runnable pipeline.
+
+    Needs the reference deployment checkout, which ships OUTSIDE this
+    repo — skipped when absent (see README "Testing"); point
+    DATAX_REFERENCE_ROOT at a checkout to run it elsewhere."""
     from data_accelerator_tpu.compile.codegen import CodegenEngine
     from data_accelerator_tpu.compile.pipeline import (
         PipelineCompiler,
@@ -355,8 +360,18 @@ def test_reference_iotsample_script_compiles():
     from data_accelerator_tpu.compile.planner import ViewSchema as VS
     from data_accelerator_tpu.compile.transform_parser import TransformParser
 
-    script = open("/root/reference/DeploymentCloud/Deployment.DataX/Samples/"
-                  "usercontent/queryupdatesample.sql").read()
+    sample = os.path.join(
+        os.environ.get("DATAX_REFERENCE_ROOT", "/root/reference"),
+        "DeploymentCloud", "Deployment.DataX", "Samples", "usercontent",
+        "queryupdatesample.sql",
+    )
+    if not os.path.exists(sample):
+        pytest.skip(
+            "reference checkout not present (queryupdatesample.sql ships "
+            "outside this repo — README 'Testing'; set "
+            "DATAX_REFERENCE_ROOT to run)"
+        )
+    script = open(sample).read()
     rc = CodegenEngine().generate_code(script, "[]", "iotsample")
     assert rc.code
 
