@@ -428,6 +428,56 @@ def bench_cold_start(capacity=None):
     }
 
 
+def bench_pilot_overhead(iters=2000):
+    """Autopilot hot-path overhead block: the pilot rides the dispatch
+    loop (``tick`` per iteration, ``admit_events`` + ``observe_poll``
+    per poll, one full ``evaluate`` per window), so its cost belongs in
+    the bench artifact next to the stage times it must stay invisible
+    beside. Measured per call in µs over a live-shaped controller
+    (actuators wired, no tracer — the recorder is its own line item)."""
+    import statistics
+
+    from data_accelerator_tpu.pilot import (
+        BackpressureActuator,
+        DepthActuator,
+        PilotConfig,
+        PilotController,
+        TokenBucket,
+        decide,
+    )
+
+    bucket = TokenBucket(base_rate=100_000.0)
+    depth = [2]
+    cfg = PilotConfig(window_s=0.0, cooldown_s=0.0)
+    pilot = PilotController(
+        cfg,
+        bucket=bucket,
+        actuators=[
+            DepthActuator(lambda: depth[0],
+                          lambda d: depth.__setitem__(0, d)),
+            BackpressureActuator(bucket),
+        ],
+    )
+    pilot._depth_probe = lambda: depth[0]
+
+    def timed(fn):
+        samples = []
+        for _ in range(8):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                fn()
+            samples.append((time.perf_counter() - t0) / iters * 1e6)
+        return round(statistics.median(samples), 3)
+
+    snap = pilot.read_signals()
+    return {
+        "decide_us": timed(lambda: decide(snap, cfg)),
+        "evaluate_us": timed(pilot.evaluate),
+        "admit_events_us": timed(lambda: pilot.admit_events(4096)),
+        "observe_poll_us": timed(lambda: pilot.observe_poll(4096, 4096)),
+    }
+
+
 def regression_gate(current: dict, tolerance: float = 0.10):
     """Trajectory gate: compare this run against the latest committed
     BENCH_r*.json and emit a ``regression`` block — events/s and p99
@@ -654,6 +704,7 @@ def main():
         "bench_context": bench_context(dec_rows_s),
         "hbm_model": hbm_model_check(proc),
         "cold_start": bench_cold_start(),
+        "pilot": bench_pilot_overhead(),
     }
     reg = regression_gate(result)
     if reg is not None:

@@ -148,6 +148,14 @@ class MetricName:
         # alert engine (obs/alerts.py): count of currently-firing rules,
         # exported every evaluation so dashboards can chart alert state
         r"Alerts_Firing",
+        # autopilot (pilot/controller.py, exported once per evaluation
+        # window): cumulative actuations applied / decisions held by
+        # budget+cooldown, the live pipeline depth the controller is
+        # running, and the backpressure token-bucket balance
+        r"Pilot_Actuations_Count",
+        r"Pilot_Suppressed_Count",
+        r"Pilot_Depth",
+        r"Pilot_Backpressure_Tokens",
         # fleet placement (serve/jobs.py FleetAdmissionGate, emitted
         # under the DATAX-Fleet app on every admission check / re-plan):
         # fleet-wide chip/flow counts, per-chip packed HBM and

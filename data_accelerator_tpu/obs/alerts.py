@@ -61,6 +61,13 @@ OPS = {
 AGGREGATES = ("avg", "max", "min", "sum", "last")
 SEVERITIES = ("info", "warn", "page")
 
+# the optional ``action`` a rule may request while firing — ONE
+# vocabulary shared with the pilot's actuation kinds
+# (pilot/controller.py ACTION_KINDS): a firing rule with an action is a
+# standing vote the controller folds into its decision table, so alerts
+# and autopilot can never drift apart on what "backpressure" means
+from ..pilot.controller import ACTION_KINDS as ACTIONS  # noqa: E402
+
 # the declarative rule contract (documented in OBSERVABILITY.md "Alert
 # rules"); validate_rules() enforces it — the CI satellite asserts every
 # default-generated rule passes
@@ -68,6 +75,7 @@ RULE_SCHEMA = {
     "name": (str, True),
     "description": (str, False),
     "severity": (str, False),        # info | warn | page
+    "action": (str, False),          # pilot actuation vote (ACTIONS)
     "windowSeconds": ((int, float), False),
     "forSeconds": ((int, float), False),
     # threshold form
@@ -149,6 +157,10 @@ def validate_rules(rules) -> List[str]:
             errors.append(
                 f"{where}: 'severity' must be one of {SEVERITIES}"
             )
+        if r.get("action") is not None and r.get("action") not in ACTIONS:
+            errors.append(
+                f"{where}: 'action' must be one of {ACTIONS}"
+            )
     return errors
 
 
@@ -196,6 +208,9 @@ def default_rules(flow: Optional[str] = None) -> List[dict]:
             "op": ">", "threshold": 2.0, "aggregate": "avg",
             "windowSeconds": 120, "forSeconds": 20,
             "severity": "warn",
+            # while firing, this rule votes for source backpressure in
+            # the pilot's decision table (one rule vocabulary)
+            "action": "backpressure",
             "description": "background result landings queuing beyond "
                            "the pipeline depth — sinks or D2H transfers "
                            "are slower than the dispatch loop",
@@ -402,6 +417,9 @@ class AlertEngine:
                 ),
                 "metric": rule.get("metric") or "batch-error-burn-rate",
                 "description": rule.get("description") or "",
+                **(
+                    {"action": rule["action"]} if rule.get("action") else {}
+                ),
             })
         return out
 
@@ -422,7 +440,7 @@ class AlertEngine:
                 **{k: rule.get(k) for k in (
                     "name", "metric", "op", "threshold", "aggregate",
                     "windowSeconds", "forSeconds", "severity",
-                    "description", "slo", "burnRate",
+                    "description", "slo", "burnRate", "action",
                 ) if rule.get(k) is not None},
                 "state": state,
                 "value": st["value"],

@@ -41,8 +41,10 @@ class HealthState:
     last-batch success, checkpoint age.
     """
 
-    # EWMA weight for the pipeline-stall gauge (recent batches dominate
-    # but one outlier stall can't flip readiness on its own)
+    # default EWMA weight for the pipeline-stall gauge (recent batches
+    # dominate but one outlier stall can't flip readiness on its own);
+    # conf ``observability.stallewmams`` overrides it as a HALF-LIFE in
+    # milliseconds of batch time — see ``stall_ewma_half_life_ms``
     STALL_EWMA_ALPHA = 0.3
 
     def __init__(
@@ -51,6 +53,7 @@ class HealthState:
         checkpoint_interval_s: Optional[float] = None,
         batch_interval_s: float = 1.0,
         stall_fail_ms: Optional[float] = None,
+        stall_ewma_half_life_ms: Optional[float] = None,
     ):
         self.flow = flow
         self.checkpoint_interval_s = checkpoint_interval_s
@@ -64,6 +67,20 @@ class HealthState:
             stall_fail_ms if stall_fail_ms is not None
             else max(10_000.0, 10.0 * batch_interval_s * 1000.0)
         )
+        # smoothing weight for record_stall: conf'd as a half-life in
+        # ms of batch time (observability.stallewmams — after one
+        # half-life of batches a level shift covers half the distance),
+        # converted to the per-sample alpha here; absent, the legacy
+        # STALL_EWMA_ALPHA applies. The pilot reads the RESULTING gauge
+        # (pipeline_stall_ms), so whatever constant readiness judges,
+        # the controller judges too.
+        if stall_ewma_half_life_ms is not None and stall_ewma_half_life_ms > 0:
+            self.stall_ewma_alpha = 1.0 - 0.5 ** (
+                max(1e-3, batch_interval_s * 1000.0)
+                / float(stall_ewma_half_life_ms)
+            )
+        else:
+            self.stall_ewma_alpha = self.STALL_EWMA_ALPHA
         self.started_at = time.time()
         self.batches_processed = 0
         self.batches_failed = 0
@@ -108,8 +125,8 @@ class HealthState:
 
     def record_stall(self, stall_ms: float) -> None:
         """Feed one batch's ``Pipeline_Stall_Ms`` into the smoothed
-        stall gauge the readiness probe judges."""
-        a = self.STALL_EWMA_ALPHA
+        stall gauge the readiness probe (and the pilot) judge."""
+        a = self.stall_ewma_alpha
         with self._lock:
             prev = self.pipeline_stall_ms
             self.pipeline_stall_ms = (

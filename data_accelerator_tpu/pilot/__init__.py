@@ -1,0 +1,39 @@
+"""Autopilot: closed-loop runtime control (ROADMAP item 5).
+
+The control plane used to deploy and *watch*; this package makes the
+runtime *act*: ``controller`` maps the observability surface to bounded
+actuations (pipeline depth, batch admission, replicas) once per
+evaluation window, ``backpressure`` is the token bucket the ingestor
+consults, and ``chaos`` packages the fault injectors the scenario
+suite uses to prove recovery both with the pilot off (baseline
+survives) and on (pilot reacts). ``python -m data_accelerator_tpu.pilot
+--replay <trace>`` re-runs any recorded decision loop offline.
+"""
+
+from .backpressure import TokenBucket
+from .controller import (
+    ACTION_KINDS,
+    Actuator,
+    BackpressureActuator,
+    Decision,
+    DepthActuator,
+    PilotConfig,
+    PilotController,
+    ScaleActuator,
+    SignalSnapshot,
+    decide,
+)
+
+__all__ = [
+    "ACTION_KINDS",
+    "Actuator",
+    "BackpressureActuator",
+    "Decision",
+    "DepthActuator",
+    "PilotConfig",
+    "PilotController",
+    "ScaleActuator",
+    "SignalSnapshot",
+    "TokenBucket",
+    "decide",
+]

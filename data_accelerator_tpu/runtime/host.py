@@ -142,9 +142,15 @@ class StreamingHost:
         # (/metrics, /healthz, /readyz — obs/exposition.py), served when
         # process.observability.port is set (0 = ephemeral port, useful
         # for tests and one-box)
-        stall_fail = dict_.get_sub_dictionary(
+        obs_conf = dict_.get_sub_dictionary(
             SettingNamespace.JobProcessPrefix + "observability."
-        ).get_double_option("stallfailms")
+        )
+        stall_fail = obs_conf.get_double_option("stallfailms")
+        # conf'd stall-EWMA half-life (observability.stallewmams): the
+        # SAME smoothed gauge feeds /readyz and the pilot's stall
+        # signal, so readiness probes and the controller agree on
+        # "stalled" by construction
+        stall_ewma = obs_conf.get_double_option("stallewmams")
         self.health = HealthState(
             flow=dict_.get_job_name(),
             checkpoint_interval_s=(
@@ -152,6 +158,7 @@ class StreamingHost:
             ),
             batch_interval_s=self.interval_s,
             stall_fail_ms=stall_fail,
+            stall_ewma_half_life_ms=stall_ewma,
         )
         # declarative alert rules from the generated conf
         # (process.alerts.rules, obs/alerts.py): evaluated every batch
@@ -231,6 +238,53 @@ class StreamingHost:
         self._landings = deque()  # futures of submitted landings, FIFO
         self._landing_failed: Optional[BaseException] = None
 
+        # live pipeline depth: starts at the conf'd depth; the pilot's
+        # DepthActuator retargets it (request_depth) and run_pipelined
+        # applies the change at a window boundary by draining the
+        # in-flight FIFO down to the new depth first, so strict-FIFO
+        # commit and whole-window requeue invariants are untouched by a
+        # resize
+        self._live_depth = max(1, self.processor.pipeline_depth)
+        self._depth_target: Optional[int] = None
+
+        # the autopilot (pilot/controller.py, conf
+        # datax.job.process.pilot.*, default on): once per evaluation
+        # window it maps the observability surface — the stall EWMA
+        # /readyz judges, landing backlog, poll saturation, malformed
+        # rate, alert-rule action votes — to bounded actuations
+        # (pipeline depth, source backpressure, replica count) through
+        # typed actuators, every decision a pilot/decide span
+        from ..pilot.controller import PilotController
+
+        self.pilot = PilotController.from_conf(dict_, host=self)
+
+    # -- pilot actuation surface ------------------------------------------
+    def live_depth(self) -> int:
+        """The commanded pipeline depth: the pending pilot target when
+        one exists, else the depth the dispatch loop is running (==
+        conf'd depth until the pilot retargets it)."""
+        return (
+            self._depth_target if self._depth_target is not None
+            else self._live_depth
+        )
+
+    def request_depth(self, depth: int) -> None:
+        """Ask the dispatch loop to resize the in-flight window; the
+        change applies at the next loop iteration, draining the window
+        down to the new depth first (FIFO) when shrinking."""
+        self._depth_target = max(1, int(depth))
+
+    def _current_depth(self, depth: int) -> int:
+        """Apply a pending pilot depth retarget (loop thread only)."""
+        if self._depth_target is not None and self._depth_target != depth:
+            logger.info(
+                "pilot depth change: %d -> %d", depth, self._depth_target
+            )
+            depth = self._depth_target
+        self._depth_target = None
+        self._live_depth = depth
+        return depth
+
     # -- loop -------------------------------------------------------------
     def _poll_and_encode(self):
         """Poll every source and encode one device batch per source;
@@ -245,6 +299,13 @@ class StreamingHost:
                 spec.capacity,
                 max(1, int(self.max_rate * self.interval_s * self._rate_scale)),
             )
+            if self.pilot is not None:
+                # source backpressure: the pilot's token bucket is the
+                # admission point — at full rate it grants pass-through,
+                # under sink/landing pressure it shrinks the poll
+                max_events = max(1, self.pilot.admit_events(max_events))
+            received = max_events
+            malformed0 = self.processor.malformed_rows_total
             if isinstance(src, LocalSource):
                 cols, now_ms, c = src.poll_columns(
                     max_events, self.processor.dictionary
@@ -265,14 +326,22 @@ class StreamingHost:
                 # decode-ahead worker never touches jax off-thread —
                 # the jitted step's call transfers it
                 blob, _n, c = src.poll_raw(max_events)
+                received = _n
                 raw[name] = self.processor.encode_json_bytes(
                     blob, (batch_time_ms // 1000) * 1000, source=name,
                     to_device=False,
                 )
             else:
                 rows, c = src.poll(max_events)
+                received = len(rows)
                 raw[name] = self.processor.encode_rows(
                     rows, (batch_time_ms // 1000) * 1000, source=name
+                )
+            if self.pilot is not None:
+                # saturation + malformed-rate signals for the window
+                self.pilot.observe_poll(
+                    max_events, received,
+                    self.processor.malformed_rows_total - malformed0,
                 )
             consumed.update(c)
         return raw, consumed, batch_time_ms, t0
@@ -587,6 +656,8 @@ class StreamingHost:
         metrics = self._finish(*self._start_batch())
         # synchronous loop: the batch's own latency is the busy time
         self._update_backpressure(metrics["Latency-Batch"])
+        if self.pilot is not None:
+            self.pilot.tick(batch_time_ms=int(time.time() * 1000))
         return metrics
 
     def run(self, max_batches: Optional[int] = None) -> None:
@@ -641,8 +712,13 @@ class StreamingHost:
         tables land and sinks ack on the background landing thread,
         bounded to at most ``depth`` queued landings (backpressure)."""
         if depth is None:
-            depth = self.processor.pipeline_depth
+            # resume from the COMMANDED depth: a pilot retarget from an
+            # earlier run persists across loop restarts (== the conf'd
+            # depth until the pilot ever actuates)
+            depth = self.live_depth()
         depth = max(1, depth)
+        self._depth_target = None
+        self._live_depth = depth
         background = self.background_transfer and self._landing_pool is not None
         # FIFO window of (PendingBatch, consumed, batch_time_ms, t0, trace)
         pending = deque()
@@ -692,7 +768,12 @@ class StreamingHost:
                     fut_trace = self.tracer.begin("streaming/batch")
                     fut = pool.submit(self._traced_poll, fut_trace)
                 pending.append((handle, consumed, batch_time_ms, t0, trace))
-                if len(pending) > depth:
+                # a pilot depth retarget lands here, at the window
+                # boundary: shrinking drains the FIFO below, growing
+                # just admits more batches — either way commit order
+                # and the requeue window are the ordinary ones
+                depth = self._current_depth(depth)
+                while len(pending) > depth:
                     # window full: retire the oldest batch (strict
                     # FIFO). depth=1 is the legacy single-`pending`
                     # overlap: finish N-1 right after dispatching N.
@@ -707,6 +788,8 @@ class StreamingHost:
                 # pipelined batch's latency spans ~depth iterations by
                 # design
                 self._update_backpressure((time.time() - iter_t0) * 1000.0)
+                if self.pilot is not None:
+                    self.pilot.tick(batch_time_ms=batch_time_ms)
             while pending and not self._stop:
                 self._check_landing_failure()
                 self._finish(
@@ -754,7 +837,11 @@ class StreamingHost:
                     "jax profiler trace written to %s", self._profiler_dir
                 )
 
-    def stop(self) -> None:
+    def stop(self, close_sources: bool = True) -> None:
+        """``close_sources=False`` tears the host down but leaves its
+        sources open — the chaos preemption drill's 'killed process':
+        a successor host takes over the surviving source/checkpoint
+        state the way a rescheduled job takes over its partitions."""
         self._stop = True
         self._stop_profiler()
         if self._landing_pool is not None:
@@ -767,8 +854,9 @@ class StreamingHost:
             self.obs_server.stop()
             self.obs_server = None
         self.dispatcher.close()
-        for s in self.sources.values():
-            s.close()
+        if close_sources:
+            for s in self.sources.values():
+                s.close()
 
 
 def main(argv=None):

@@ -1072,6 +1072,10 @@ class FlowProcessor:
         # host-side ingest counters (e.g. rows dropped for garbage
         # timestamps), drained into metrics at each collect
         self.ingest_stats: Dict[str, int] = {}
+        # monotonic malformed-line total (never cleared — the host's
+        # pilot reads per-poll deltas off it, so the collect-time drain
+        # of ingest_stats can't race the flood signal)
+        self.malformed_rows_total = 0
         self._native_decoders: Dict[str, object] = {}
 
     def reset_state(self) -> None:
@@ -1282,15 +1286,22 @@ class FlowProcessor:
             import json as _json
 
             rows = []
+            malformed = 0
             for ln in data.splitlines():
                 if not ln.strip():
                     continue
                 try:
                     rows.append(_json.loads(ln))
                 except ValueError:
-                    continue  # skip malformed lines like the native path
+                    malformed += 1  # skip malformed lines, but count
+                    continue        # them: the pilot's flood signal
                 if len(rows) >= spec.capacity:
                     break
+            if malformed:
+                self.ingest_stats["malformed_rows"] = (
+                    self.ingest_stats.get("malformed_rows", 0) + malformed
+                )
+                self.malformed_rows_total += malformed
             return self.encode_rows(rows, base_ms, source=spec.name)
 
         decoder = self._native_decoders.get(spec.name)
@@ -1300,6 +1311,22 @@ class FlowProcessor:
             decoder = NativeDecoder(spec.schema, self.dictionary)
             self._native_decoders[spec.name] = decoder
         arrays, valid, rows, _consumed = decoder.decode(data, spec.capacity)
+        # malformed lines in the consumed range = newline count minus
+        # decoded rows (the decoder zero-gaps them); feeds the
+        # Input_malformed_rows_Count metric and the pilot flood signal
+        consumed_blob = data[:_consumed] if _consumed else data
+        # allocation-free line count (bytes.count is C): blank lines
+        # are rare enough that miscounting one as malformed can't move
+        # the pilot's 30% flood threshold
+        lines_seen = consumed_blob.count(b"\n")
+        if consumed_blob and not consumed_blob.endswith(b"\n"):
+            lines_seen += 1
+        malformed = max(0, lines_seen - int(rows))
+        if malformed:
+            self.ingest_stats["malformed_rows"] = (
+                self.ingest_stats.get("malformed_rows", 0) + malformed
+            )
+            self.malformed_rows_total += malformed
         if decoder.last_bad_timestamps:
             self.ingest_stats["bad_timestamps"] = (
                 self.ingest_stats.get("bad_timestamps", 0)

@@ -91,6 +91,7 @@ class RuntimeConfigGeneration:
             self._s600_job_configs,
             self._s620_conformance,
             self._s630_compile,
+            self._s640_pilot,
             self._s650_flatten,
             self._s700_write_files,
             self._s800_jobs,
@@ -585,6 +586,33 @@ class RuntimeConfigGeneration:
                 f"{ctx['flow_dir']}/compilecache".replace(os.sep, "/")
             )
 
+    def _s640_pilot(self, ctx) -> None:
+        """Wire the autopilot (``pilot/controller.py``) into the
+        generated conf: ``datax.job.process.pilot.*`` from the designer
+        ``jobPilot*`` knobs. Default ON — a generated job runs piloted
+        (depth/backpressure actuation bounded by budget + cooldown)
+        unless the designer sets ``jobPilot: "false"``. The stall-EWMA
+        half-life (``jobStallEwmaMs`` ->
+        ``observability.stallewmams``) rides along so /readyz and the
+        controller judge "stalled" off one conf'd constant."""
+        doc = ctx["doc"]
+        jobconf = (doc["gui"].get("process") or {}).get("jobconfig") or {}
+        keys: Dict[str, str] = {}
+        if str(jobconf.get("jobPilot", "")).lower() == "false":
+            keys["datax.job.process.pilot.enabled"] = "false"
+        for gui_key, conf_key in (
+            ("jobPilotWindowSeconds", "pilot.windowseconds"),
+            ("jobPilotCooldownSeconds", "pilot.cooldownseconds"),
+            ("jobPilotBudget", "pilot.budget"),
+            ("jobPilotMaxDepth", "pilot.maxdepth"),
+            ("jobPilotMaxReplicas", "pilot.maxreplicas"),
+            ("jobStallEwmaMs", "observability.stallewmams"),
+        ):
+            v = jobconf.get(gui_key)
+            if v not in (None, ""):
+                keys[f"datax.job.process.{conf_key}"] = str(v)
+        ctx["pilot_keys"] = keys
+
     def _s650_flatten(self, ctx) -> None:
         """Flatten each resolved job config JSON to flat conf text
         (S650 ConfigFlattener.Flatten)."""
@@ -633,6 +661,7 @@ class RuntimeConfigGeneration:
                 for k, v in b.items():
                     if v:
                         extra[f"{ns}.{k.lower()}"] = str(v)
+            extra.update(ctx.get("pilot_keys") or {})
             extra.update(ctx.get("multi_source_keys") or {})
             flat.update(extra)
             conf_text = "\n".join(f"{k}={v}" for k, v in sorted(flat.items()))

@@ -207,6 +207,64 @@ class FleetAdmissionGate:
         }
         return job
 
+    def admit_replicas(self, job: dict, count: int) -> None:
+        """Vet an in-place rescale: would ``count`` replicas of this
+        job's flow still pack onto the fleet? Replicas of ONE flow
+        intentionally share checkpoint dirs / consumer groups / metric
+        series (that's what makes them a competing-consumer group), so
+        only the CAPACITY codes gate here — DX400/DX401 over ``count``
+        copies of the flow's footprint plus every other active flow.
+        Raises ``FleetAdmissionError`` BEFORE any process spawns."""
+        import dataclasses
+
+        flow_name = job.get("flow")
+        doc = self.design.get_by_name(flow_name) if flow_name else None
+        if doc is None or count <= 1:
+            return
+        from ..analysis import analyze_fleet
+
+        base = self._footprint(flow_name, doc)
+        footprints = [base] + [
+            dataclasses.replace(
+                base, name=f"{flow_name}~r{i}",
+                # suffixed shadow footprints drop the shared-resource
+                # claims so the interference lints don't see the
+                # intentional sharing as cross-flow collisions
+                dirs=set(), consumer_keys=set(), metric_series=set(),
+                obs_port=None,
+            )
+            for i in range(2, count + 1)
+        ]
+        for name in self._active_flow_names(exclude_flow=flow_name):
+            other = self.design.get_by_name(name)
+            if other is not None:
+                footprints.append(self._footprint(name, other))
+        with tracing.span("rescale/placement", flow=flow_name, count=count):
+            report = analyze_fleet(footprints, spec=self.spec)
+        self._export_metrics(report)
+        gating = [
+            d for d in report.diagnostics
+            if d.code in ("DX400", "DX401")
+            and (not d.table or flow_name in d.table.split("/")
+                 or any(f"{flow_name}~r" in part
+                        for part in d.table.split("/")))
+        ]
+        if gating:
+            self.rejected_count += 1
+            job["rescale"] = {
+                "requested": count,
+                "admitted": False,
+                "codes": [d.code for d in gating],
+                "reason": "; ".join(d.render() for d in gating),
+            }
+            self.registry.upsert(job)
+            self.metrics.send_metric(
+                "Fleet_AdmissionRejected_Count", self.rejected_count
+            )
+            raise FleetAdmissionError(job["name"], gating)
+        job["rescale"] = {"requested": count, "admitted": True, "codes": []}
+        self.registry.upsert(job)
+
     def replan(self):
         """Recompute placement over the currently running flows (freed
         capacity becomes reusable) and refresh every active job
@@ -702,6 +760,71 @@ class JobOperation:
 
     def stop_job_with_retries(self, job_name: str) -> dict:
         return self._with_retries(lambda: self.stop_job(job_name))
+
+    # -- in-place rescale -------------------------------------------------
+    def replica_records(self, job_name: str) -> List[dict]:
+        """The job's live replica records (``replicaOf`` == job, state
+        running/starting — stopped replicas stay in the registry as
+        history, like any stopped job), in replica order."""
+        out = [
+            r for r in self.registry.get_all()
+            if r.get("replicaOf") == job_name
+            and r.get("state") in (JobState.Running, JobState.Starting)
+        ]
+        out.sort(key=lambda r: r.get("replicaIndex") or 0)
+        return out
+
+    def rescale(self, job_name: str, replicas: int) -> List[dict]:
+        """In-place replica scaling — the path a replica-count change
+        used to require a stop+start for. ``replicas`` counts the base
+        job plus ``<job>-rN`` replica records sharing its conf (a
+        competing-consumer group against the same source). Scale-UP is
+        vetted by the fleet admission gate BEFORE any process spawns
+        (``FleetAdmissionGate.admit_replicas`` — capacity codes over N
+        copies of the flow's footprint); scale-DOWN stops the
+        highest-numbered replicas first. The replanner refreshes
+        placement after every change. Returns the live record set
+        (base + replicas)."""
+        base = self.sync_job_state(job_name)
+        replicas = max(1, int(replicas))
+        live = self.replica_records(job_name)
+        have = 1 + len(live)
+        if replicas > have:
+            if self.admission_gate is not None:
+                # raises FleetAdmissionError (recording the rejection
+                # on the base record) before the client spawns anything
+                self.admission_gate.admit_replicas(base, replicas)
+            taken = {r.get("replicaIndex") for r in live}
+            idx = 2
+            for _ in range(replicas - have):
+                while idx in taken:
+                    idx += 1
+                taken.add(idx)
+                rec = {
+                    "name": f"{job_name}-r{idx}",
+                    "flow": base.get("flow"),
+                    "confPath": base.get("confPath"),
+                    "replicaOf": job_name,
+                    "replicaIndex": idx,
+                    "state": JobState.Idle,
+                }
+                with tracing.span(
+                    "rescale/submit", job=rec["name"], of=job_name
+                ):
+                    parent = tracing.format_parent(tracing.capture())
+                    if parent is not None:
+                        rec["parentTrace"] = parent
+                    rec = self.client.submit(rec)
+                self.registry.upsert(rec)
+                live.append(rec)
+        elif replicas < have:
+            # stop the highest-numbered replicas first (the base job is
+            # never stopped by a rescale — replicas floor at 1)
+            for rec in list(reversed(live))[: have - replicas]:
+                rec = self.client.stop(rec)
+                self.registry.upsert(rec)
+        self._notify_replanner()
+        return [base] + self.replica_records(job_name)
 
     def restart_job(self, job_name: str, batches: Optional[int] = None) -> dict:
         self.stop_job_with_retries(job_name)

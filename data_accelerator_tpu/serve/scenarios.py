@@ -191,3 +191,426 @@ def default_suite(base_url: str, token: Optional[str] = None):
         save_and_deploy(base_url, token=token),
         schema_and_query(base_url, token=token),
     ]
+
+
+# ---------------------------------------------------------------------------
+# Chaos scenario suite (ROADMAP item 5): in-process fault drills over a
+# live StreamingHost, each asserting exactly-once-per-window recovery
+# with the pilot DISABLED (baseline survives) and — pilot ENABLED —
+# additionally that the expected actuation fired (pilot/chaos.py holds
+# the injectors; the tier-1 suite runs these at depth 2).
+# ---------------------------------------------------------------------------
+_CHAOS_SCHEMA = json.dumps({"type": "struct", "fields": [
+    {"name": "k", "type": "long", "nullable": False, "metadata": {}},
+    {"name": "v", "type": "double", "nullable": False, "metadata": {}},
+    {"name": "seq", "type": "long", "nullable": False, "metadata": {}},
+]})
+
+_CHAOS_TRANSFORM = (
+    "--DataXQuery--\n"
+    "Out = SELECT k, v, seq FROM DataXProcessedInput\n"
+    "--DataXQuery--\n"
+    "Hot = SELECT k, COUNT(*) AS c FROM DataXProcessedInput GROUP BY k\n"
+)
+
+
+def _chaos_events(n: int) -> list:
+    return [{"k": i % 4, "v": float(i), "seq": i} for i in range(n)]
+
+
+def _chaos_payload(rows) -> bytes:
+    return b"".join(json.dumps(r).encode() + b"\n" for r in rows)
+
+
+def _build_chaos_host(ctx, name: str, pilot: bool, depth: int = 2,
+                      pilot_conf: Optional[dict] = None,
+                      reuse_source: bool = False):
+    """One socket-fed StreamingHost with a RecordingSink on ``Out`` —
+    the shared fixture every chaos scenario drills. ``ctx['workdir']``
+    is the only required input. ``reuse_source`` rebuilds the host over
+    the surviving source (the preemption-recovery 'new process')."""
+    import os
+
+    from ..core.config import SettingDictionary
+    from ..pilot.chaos import RecordingSink
+    from ..runtime.host import StreamingHost
+    from ..runtime.sources import SocketSource
+
+    workdir = ctx["workdir"]
+    tpath = os.path.join(workdir, "chaos.transform")
+    if not os.path.exists(tpath):
+        with open(tpath, "w", encoding="utf-8") as f:
+            f.write(_CHAOS_TRANSFORM)
+    conf = {
+        "datax.job.name": name,
+        "datax.job.input.default.blobschemafile": _CHAOS_SCHEMA,
+        "datax.job.input.default.eventhub.maxrate": "4",
+        "datax.job.input.default.eventhub.checkpointdir": os.path.join(
+            workdir, "ckpt"
+        ),
+        "datax.job.input.default.eventhub.checkpointinterval": "0 second",
+        "datax.job.input.default.streaming.intervalinseconds": "1",
+        "datax.job.process.transform": tpath,
+        "datax.job.process.batchcapacity": "8",
+        "datax.job.process.pipeline.depth": str(depth),
+        "datax.job.process.telemetry.tracefile": os.path.join(
+            workdir, "trace.jsonl"
+        ),
+        "datax.job.output.Out.console.maxrows": "0",
+        "datax.job.output.Hot.console.maxrows": "0",
+    }
+    if pilot:
+        # tight loop knobs so evaluation windows elapse inside a drill
+        conf.update({
+            "datax.job.process.pilot.windowseconds": "0.02",
+            "datax.job.process.pilot.cooldownseconds": "0.02",
+            "datax.job.process.observability.stallewmams": "200",
+        })
+        for k, v in (pilot_conf or {}).items():
+            conf[f"datax.job.process.pilot.{k}"] = str(v)
+    else:
+        conf["datax.job.process.pilot.enabled"] = "false"
+    if reuse_source and ctx.get("src") is not None:
+        src = ctx["src"]
+    else:
+        src = SocketSource(port=0)
+    host = StreamingHost(SettingDictionary(conf), source=src)
+    sink = RecordingSink()
+    host.dispatcher.operators["Out"].sinks = [sink]
+    # the grouped (hot-key) output records too — keeps the drill
+    # assertable and the console quiet; only Out carries the
+    # exactly-once witness (per-event seq)
+    ctx["hot_sink"] = RecordingSink()
+    host.dispatcher.operators["Hot"].sinks = [ctx["hot_sink"]]
+    ctx["host"], ctx["src"], ctx["sink"] = host, src, sink
+    ctx.setdefault("sinks", []).append(sink)
+    ctx["tracefile"] = conf["datax.job.process.telemetry.tracefile"]
+    return host
+
+
+def _delivered(ctx) -> list:
+    return [
+        seq for sink in ctx.get("sinks", []) for seq in sink.values("seq")
+    ]
+
+
+def _assert_exactly_once(ctx, n: int) -> None:
+    seqs = _delivered(ctx)
+    assert sorted(seqs) == list(range(n)), (
+        f"exactly-once violated: {len(seqs)} deliveries of {n} events; "
+        f"dupes/losses over {sorted(set(range(n)) ^ set(seqs))[:10]}"
+    )
+
+
+def _assert_pilot_reacted(ctx, action: str) -> None:
+    """Pilot-on acceptance: the expected actuation fired, the
+    Pilot_Actuations_Count series is > 0, and the actuation is visible
+    as a ``pilot/decide`` span in the flight recorder."""
+    host = ctx["host"]
+    pilot = host.pilot
+    assert pilot is not None
+    applied = [
+        d for d in ctx.get("applied_decisions", [])
+        if d.applied and d.action == action
+    ]
+    assert applied, (
+        f"no applied '{action}' actuation; decisions="
+        f"{[(d.rule, d.action, d.suppressed) for d in ctx.get('applied_decisions', [])]}"
+    )
+    pts = host.metric_logger.store.points(
+        host.metric_logger.key("Pilot_Actuations_Count")
+    )
+    assert pts and float(pts[-1]["val"]) > 0, "Pilot_Actuations_Count not > 0"
+    spans = []
+    with open(ctx["tracefile"], encoding="utf-8") as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("type") == "span" and rec.get("name") == "pilot/decide":
+                spans.append(rec)
+    acted = [
+        s for s in spans
+        if s.get("properties", {}).get("applied")
+        and s["properties"].get("action") == action
+    ]
+    assert acted, f"no applied pilot/decide span for '{action}'"
+
+
+def _drain(ctx, host, expect_total: int, chunk: int = 4,
+           timeout_s: float = 30.0):
+    """Run the pipelined loop in chunks until every expected event has
+    landed (backpressure may shrink polls, so a fixed batch count can't
+    know when the stream is drained), accumulating every pilot
+    decision along the way. If the drain finished before an evaluation
+    window ever elapsed, evaluate once directly — the signals (all
+    EWMAs) are still live; only the wall-clock cadence is forced."""
+    collected = ctx.setdefault("applied_decisions", [])
+    pilot = host.pilot
+    orig_evaluate = pilot.evaluate if pilot is not None else None
+
+    def evaluate(*a, **k):
+        ds = orig_evaluate(*a, **k)
+        collected.extend(ds)
+        return ds
+
+    if pilot is not None:
+        pilot.evaluate = evaluate
+    try:
+        deadline = time.time() + timeout_s
+        while len(_delivered(ctx)) < expect_total:
+            # max_batches counts batches over the host's LIFETIME, so
+            # each chunk extends the allowance past what's done
+            host.run_pipelined(
+                max_batches=host.batches_processed + chunk
+            )
+            if time.time() > deadline:
+                raise AssertionError(
+                    f"drain timed out: {len(_delivered(ctx))}/"
+                    f"{expect_total} delivered"
+                )
+        if pilot is not None and not any(d.applied for d in collected):
+            evaluate()
+    finally:
+        if pilot is not None:
+            pilot.evaluate = orig_evaluate
+
+
+def chaos_preemption(pilot: bool = False, depth: int = 2) -> Scenario:
+    """Job kill/restart mid-window: the 3rd dispatch dies with batches
+    in flight (TPU preemption analog), a fresh host over the same
+    checkpoint dir + requeued source recovers, and every event lands
+    exactly once. Pilot-on: the recovery backlog saturates ingest at
+    the depth ceiling -> the pilot asks for a replica
+    (``rescale-up`` through a ScaleActuator, vetted path)."""
+    sc = Scenario(f"ChaosPreemption{'Pilot' if pilot else ''}")
+    n_events = 32
+
+    @sc.step
+    def build_host(ctx):
+        _build_chaos_host(
+            ctx, "ChaosPreemptP" if pilot else "ChaosPreemptB", pilot, depth,
+            # cap depth so sustained saturation escalates to rescale
+            pilot_conf={"maxdepth": depth, "saturationhigh": "0.5"},
+        )
+
+    @sc.step
+    def feed_events(ctx):
+        from ..pilot.chaos import feed_socket
+
+        feed_socket(ctx["src"], _chaos_payload(_chaos_events(n_events)),
+                    expect_events=n_events)
+
+    @sc.step
+    def preempt_mid_window(ctx):
+        from ..pilot.chaos import ChaosFault, PreemptionInjector
+
+        inj = PreemptionInjector(kill_at_dispatch=3)
+        inj.arm(ctx["host"])
+        try:
+            ctx["host"].run_pipelined(max_batches=n_events // 4)
+        except ChaosFault:
+            ctx["preempted"] = True
+        finally:
+            inj.disarm()
+            # the 'killed process': tear down without closing the
+            # source — the successor host takes it over
+            ctx["host"].stop(close_sources=False)
+        assert ctx.get("preempted"), "injector never fired"
+
+    @sc.step
+    def recover_with_fresh_host(ctx):
+        from ..pilot.controller import ScaleActuator
+        from ..pilot.chaos import RecordingRescaler
+
+        ctx["src"].requeue_unacked()
+        host = _build_chaos_host(
+            ctx, "ChaosPreemptP" if pilot else "ChaosPreemptB", pilot, depth,
+            pilot_conf={"maxdepth": depth, "saturationhigh": "0.5"},
+            reuse_source=True,
+        )
+        if pilot and host.pilot is not None:
+            scaler = ctx["scaler"] = RecordingRescaler()
+            act = ScaleActuator(scaler, "ChaosPreempt", max_replicas=4)
+            for kind in act.kinds:
+                host.pilot.actuators[kind] = act
+        _drain(ctx, host, n_events)
+        host.stop()
+
+    @sc.step
+    def assert_recovered_exactly_once(ctx):
+        _assert_exactly_once(ctx, n_events)
+
+    if pilot:
+        @sc.step
+        def assert_pilot_rescaled(ctx):
+            _assert_pilot_reacted(ctx, "rescale-up")
+            assert ctx["scaler"].calls and ctx["scaler"].calls[0] >= 2
+
+    return sc
+
+
+def chaos_sink_outage(pilot: bool = False, depth: int = 2) -> Scenario:
+    """Sink outage: a hard outage mid-window fails the batch — the
+    whole un-acked window requeues (FIFO commit holds) — then the sink
+    comes back SLOW (brown-out): landings queue behind the dispatch
+    loop and, pilot-on, the landing-backlog signal engages source
+    backpressure (the token bucket shrinks polls)."""
+    sc = Scenario(f"ChaosSinkOutage{'Pilot' if pilot else ''}")
+    n_events = 24
+
+    @sc.step
+    def build_host(ctx):
+        _build_chaos_host(ctx, "ChaosSinkP" if pilot else "ChaosSinkB", pilot, depth,
+                          pilot_conf={"backloghigh": "2"})
+
+    @sc.step
+    def feed_events(ctx):
+        from ..pilot.chaos import feed_socket
+
+        feed_socket(ctx["src"], _chaos_payload(_chaos_events(n_events)),
+                    expect_events=n_events)
+
+    @sc.step
+    def hard_outage_requeues_window(ctx):
+        from ..pilot.chaos import ChaosFault, SinkOutageInjector
+
+        inj = SinkOutageInjector(fail=True)
+        inj.arm(ctx["host"])
+        try:
+            ctx["host"].run_pipelined(max_batches=n_events // 4)
+        except ChaosFault:
+            ctx["outage_hit"] = True
+        finally:
+            inj.disarm()
+        assert ctx.get("outage_hit"), "outage never hit a write"
+        ctx["src"].requeue_unacked()
+
+    @sc.step
+    def brownout_recovery(ctx):
+        from ..pilot.chaos import SinkOutageInjector
+
+        inj = SinkOutageInjector(delay_s=0.08)
+        inj.arm(ctx["host"])
+        try:
+            _drain(ctx, ctx["host"], n_events)
+        finally:
+            inj.disarm()
+            ctx["host"].stop()
+
+    @sc.step
+    def assert_recovered_exactly_once(ctx):
+        _assert_exactly_once(ctx, n_events)
+
+    if pilot:
+        @sc.step
+        def assert_pilot_backpressured(ctx):
+            _assert_pilot_reacted(ctx, "backpressure")
+
+    return sc
+
+
+def chaos_hot_key_skew(pilot: bool = False, depth: int = 2) -> Scenario:
+    """Hot-key skew: 90% of events hammer one group key and the device
+    step slows under the serialized hot group (DeviceSlowdownInjector
+    models the skewed groupby scan) — the dispatch loop stalls on the
+    window's oldest batch. Pilot-on: the smoothed stall (the SAME
+    conf'd EWMA /readyz judges) crosses ``stallhighms`` and the pilot
+    drops pipeline depth, draining the window FIFO-first."""
+    sc = Scenario(f"ChaosHotKeySkew{'Pilot' if pilot else ''}")
+    n_events = 32
+
+    @sc.step
+    def build_host(ctx):
+        _build_chaos_host(ctx, "ChaosSkewP" if pilot else "ChaosSkewB", pilot, depth,
+                          pilot_conf={"stallhighms": "20"})
+
+    @sc.step
+    def feed_skewed_events(ctx):
+        from ..pilot.chaos import feed_socket, skewed_events
+
+        rows = skewed_events(n_events, hot_key=0, hot_fraction=0.9)
+        feed_socket(ctx["src"], _chaos_payload(rows),
+                    expect_events=n_events)
+
+    @sc.step
+    def run_under_skew(ctx):
+        from ..pilot.chaos import DeviceSlowdownInjector
+
+        inj = DeviceSlowdownInjector(extra_s=0.06)
+        inj.arm(ctx["host"])
+        try:
+            _drain(ctx, ctx["host"], n_events)
+        finally:
+            inj.disarm()
+            ctx["host"].stop()
+
+    @sc.step
+    def assert_exactly_once_under_skew(ctx):
+        _assert_exactly_once(ctx, n_events)
+
+    if pilot:
+        @sc.step
+        def assert_pilot_dropped_depth(ctx):
+            _assert_pilot_reacted(ctx, "depth-down")
+            assert ctx["host"].live_depth() < depth, (
+                f"depth still {ctx['host'].live_depth()}"
+            )
+
+    return sc
+
+
+def chaos_malformed_flood(pilot: bool = False, depth: int = 2) -> Scenario:
+    """Malformed-input flood: half the stream is garbage (truncated
+    JSON, binary noise). The decoders skip bad lines — every VALID
+    event still lands exactly once — and, pilot-on, the malformed-rate
+    signal engages backpressure so the host stops burning batch
+    capacity decoding garbage at full rate."""
+    sc = Scenario(f"ChaosMalformedFlood{'Pilot' if pilot else ''}")
+    n_valid = 16
+
+    @sc.step
+    def build_host(ctx):
+        _build_chaos_host(ctx, "ChaosFloodP" if pilot else "ChaosFloodB", pilot, depth,
+                          pilot_conf={"malformedhigh": "0.3"})
+
+    @sc.step
+    def feed_flood(ctx):
+        from ..pilot.chaos import feed_socket, malformed_payload
+
+        payload = malformed_payload(
+            _chaos_events(n_valid), flood_ratio=0.5
+        )
+        ctx["total_lines"] = payload.count(b"\n")
+        feed_socket(ctx["src"], payload,
+                    expect_events=ctx["total_lines"])
+
+    @sc.step
+    def run_through_flood(ctx):
+        _drain(ctx, ctx["host"], n_valid)
+        ctx["host"].stop()
+
+    @sc.step
+    def assert_valid_events_exactly_once(ctx):
+        _assert_exactly_once(ctx, n_valid)
+
+    if pilot:
+        @sc.step
+        def assert_pilot_backpressured(ctx):
+            _assert_pilot_reacted(ctx, "backpressure")
+
+    return sc
+
+
+def chaos_suite(pilot: bool = False, depth: int = 2):
+    """All four chaos drills (preemption, sink outage, hot-key skew,
+    malformed flood) — the scenario-diversity matrix PILOT.md tables.
+    Each scenario needs a fresh ``ScenarioContext`` with a
+    ``workdir``."""
+    return [
+        chaos_preemption(pilot=pilot, depth=depth),
+        chaos_sink_outage(pilot=pilot, depth=depth),
+        chaos_hot_key_skew(pilot=pilot, depth=depth),
+        chaos_malformed_flood(pilot=pilot, depth=depth),
+    ]

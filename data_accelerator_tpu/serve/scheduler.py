@@ -33,9 +33,13 @@ class PlacementReplanner:
     flow->chip assignments (``JobRegistry`` records' ``placement``) and
     the ``Fleet_*``/``Placement_*`` metrics in step with the set of
     jobs actually running. ``JobOperation`` calls ``on_job_event`` after
-    every successful start/stop; ``TimedScheduler`` additionally calls
-    it each tick so jobs that die on their own (crash, batch-mode
-    completion) also release their modeled capacity.
+    every successful start/stop AND after every in-place
+    ``JobOperation.rescale`` (a replica-count change no longer needs a
+    stop+start round trip — the rescale path re-runs admission through
+    ``FleetAdmissionGate.admit_replicas`` before spawning, then lands
+    here so the new replica set's placement persists); ``TimedScheduler``
+    additionally calls it each tick so jobs that die on their own
+    (crash, batch-mode completion) also release their modeled capacity.
     """
 
     def __init__(self, gate):

@@ -824,6 +824,28 @@ route("#/metrics", async (view, hash) => {
     pctlEls[`Latency-Batch-${p}`] = $(".v", tile);
   }
   view.append(h("h2", {}, "Latency percentiles"), pctlTiles);
+
+  /* autopilot tile (pilot/controller.py): the controller's live state —
+     commanded pipeline depth, backpressure token balance, cumulative
+     actuations — as a dedicated stat row so "is the pilot flying this
+     job?" is one glance, not a hunt through the generic metric tiles */
+  const PILOT_METRICS = [
+    ["Pilot_Depth", "pilot depth"],
+    ["Pilot_Backpressure_Tokens", "backpressure tokens"],
+    ["Pilot_Actuations_Count", "pilot actuations"],
+  ];
+  const pilotTiles = h("div", { class: "tiles" });
+  const pilotEls = {};
+  for (const [metric, label] of PILOT_METRICS) {
+    const tile = h("div", { class: "tile" },
+      h("div", { class: "k" }, label),
+      h("div", { class: "v" }, "–"));
+    pilotTiles.append(tile);
+    pilotEls[metric] = $(".v", tile);
+  }
+  const pilotSection = h("div", { style: "display:none" },
+    h("h2", {}, "Autopilot"), pilotTiles);
+  view.append(pilotSection);
   const stageChartBox = h("div", {});
   view.append(stageChartBox);
   const STAGE_PCTL = "p95";
@@ -845,6 +867,11 @@ route("#/metrics", async (view, hash) => {
        spawning one generic chart per metric (24 series otherwise) */
     if (pctlEls[metric]) {
       pctlEls[metric].childNodes[0].textContent = fmtVal(point.val);
+      return true;
+    }
+    if (pilotEls[metric]) {
+      pilotSection.style.display = "";
+      pilotEls[metric].textContent = fmtVal(point.val);
       return true;
     }
     if (stageKeyOf[metric]) {
@@ -877,10 +904,17 @@ route("#/metrics", async (view, hash) => {
     routePoint(metric, history[history.length - 1]);
   };
 
+  const seedPilot = async (metric) => {
+    const history = await fetch(
+      `/metrics/history?key=${encodeURIComponent(prefix + metric)}`).then((r) => r.json());
+    if (history.length) routePoint(metric, history[history.length - 1]);
+  };
+
   const keys = await fetch(`/metrics/keys?prefix=${encodeURIComponent(prefix)}`)
     .then((r) => r.json());
   await Promise.all(keys.sort().map((k) => {
     const metric = k.slice(prefix.length);
+    if (pilotEls[metric]) return seedPilot(metric);
     return LATENCY_PCTL_RE.test(metric) ? seedLatency(metric) : ensure(metric);
   }));
 

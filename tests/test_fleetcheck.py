@@ -550,3 +550,79 @@ def test_admission_gate_exports_fleet_metrics(tmp_path):
     # every name the gate emits is a registered engine metric
     for m in metrics:
         assert MetricName.is_runtime_metric(m), m
+
+
+# ---------------------------------------------------------------------------
+# in-place rescale: admission re-runs BEFORE spawning (PR 10 satellite,
+# mirroring the submit-gate no-Popen proof above)
+# ---------------------------------------------------------------------------
+def test_rescale_rejected_before_spawn(tmp_path, monkeypatch):
+    """A replica-count change no longer needs stop+start: the in-place
+    ``JobOperation.rescale`` path re-runs fleet admission over N copies
+    of the flow's footprint, and a capacity reject (DX400) lands BEFORE
+    any replica process spawns — the base job keeps running."""
+    from data_accelerator_tpu.serve import jobs as jobs_mod
+    from data_accelerator_tpu.serve.jobs import (
+        FleetAdmissionError,
+        LocalJobClient,
+    )
+
+    spy = _SpyPopen()
+    monkeypatch.setattr(jobs_mod.subprocess, "Popen", spy)
+    spec = FleetSpec.from_dict(ONE_CHIP_TINY)  # one flow fits, two don't
+    ops = _make_ops(
+        tmp_path, LocalJobClient(log_dir=str(tmp_path / "logs")), spec=spec
+    )
+    ops.save_flow(_tiny_gui("solo"))
+    res = ops.generate_configs("solo")
+    assert res.ok, res.errors
+    [job] = ops.start_jobs("solo")
+    assert len(spy.calls) == 1
+
+    with pytest.raises(FleetAdmissionError) as ei:
+        ops.jobs.rescale(job["name"], 2)
+    assert len(spy.calls) == 1  # NO replica process spawned
+    assert any(d.code == "DX400" for d in ei.value.diagnostics)
+    rec = ops.registry.get(job["name"])
+    assert rec["rescale"]["admitted"] is False
+    assert "DX400" in rec["rescale"]["codes"]
+    assert ops.jobs.replica_records(job["name"]) == []
+
+
+def test_rescale_up_then_down_in_place(tmp_path, monkeypatch):
+    """On a fleet with room, rescale(3) spawns exactly two ``<job>-rN``
+    replica records through the vetted path (and replans placement);
+    rescale(1) stops the highest-numbered replicas first, never the
+    base job."""
+    from data_accelerator_tpu.serve import jobs as jobs_mod
+    from data_accelerator_tpu.serve.jobs import JobState, LocalJobClient
+
+    spy = _SpyPopen()
+    monkeypatch.setattr(jobs_mod.subprocess, "Popen", spy)
+    spec = FleetSpec.from_dict({**ONE_CHIP_TINY, "chips": 4})
+    ops = _make_ops(
+        tmp_path, LocalJobClient(log_dir=str(tmp_path / "logs")), spec=spec
+    )
+    ops.save_flow(_tiny_gui("elastic"))
+    res = ops.generate_configs("elastic")
+    assert res.ok, res.errors
+    [job] = ops.start_jobs("elastic")
+    replans_before = ops.placement.replans
+
+    records = ops.jobs.rescale(job["name"], 3)
+    assert len(spy.calls) == 3  # base + two replicas
+    assert [r["name"] for r in records] == [
+        job["name"], f"{job['name']}-r2", f"{job['name']}-r3",
+    ]
+    rec = ops.registry.get(job["name"])
+    assert rec["rescale"] == {"requested": 3, "admitted": True, "codes": []}
+    assert ops.registry.get(f"{job['name']}-r2")["replicaOf"] == job["name"]
+    assert ops.placement.replans > replans_before  # placement refreshed
+
+    records = ops.jobs.rescale(job["name"], 1)
+    assert len(spy.calls) == 3  # scale-down spawns nothing
+    assert [r["name"] for r in records] == [job["name"]]
+    assert ops.registry.get(
+        f"{job['name']}-r3"
+    )["state"] == JobState.Idle  # highest replica stopped first
+    assert ops.registry.get(job["name"])["state"] != JobState.Idle

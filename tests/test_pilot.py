@@ -1,0 +1,564 @@
+"""Autopilot unit suite (pilot/controller.py, pilot/backpressure.py,
+pilot/__main__.py): the decision table row by row (signal snapshot in
+-> actuation out), budget/cooldown enforcement, the no-flap property
+under an oscillating synthetic signal, the token bucket's mechanics,
+conf plumbing (pilot.* + the shared stall-EWMA constant), and the
+offline replay CLI. The chaos drills that prove the loop end-to-end
+live in test_chaos.py."""
+
+import json
+
+import pytest
+
+from data_accelerator_tpu.pilot import (
+    ACTION_KINDS,
+    BackpressureActuator,
+    Decision,
+    DepthActuator,
+    PilotConfig,
+    PilotController,
+    ScaleActuator,
+    SignalSnapshot,
+    TokenBucket,
+    decide,
+)
+
+CFG = PilotConfig()
+
+
+def actions(snap, cfg=CFG):
+    return [d.action for d in decide(snap, cfg)]
+
+
+# ---------------------------------------------------------------------------
+# decision table: one test per rule row
+# ---------------------------------------------------------------------------
+class TestDecisionTable:
+    def test_steady_state_decides_nothing(self):
+        assert actions(SignalSnapshot(depth=2)) == []
+
+    def test_landing_backlog_engages_backpressure(self):
+        snap = SignalSnapshot(backlog=CFG.backlog_high, depth=2)
+        ds = decide(snap, CFG)
+        assert [d.action for d in ds] == ["backpressure"]
+        assert ds[0].rule == "landing-backlog-backpressure"
+
+    def test_alert_action_vote_engages_backpressure(self):
+        """Satellite: a firing alert rule carrying action=backpressure
+        is a standing vote the table honors even before the backlog
+        threshold trips — one rule vocabulary."""
+        snap = SignalSnapshot(alert_actions=("backpressure",), depth=2)
+        ds = decide(snap, CFG)
+        assert [d.action for d in ds] == ["backpressure"]
+        assert ds[0].rule == "alert-requested-backpressure"
+
+    def test_malformed_flood_engages_backpressure(self):
+        snap = SignalSnapshot(malformed_ratio=0.5, depth=2)
+        assert "backpressure" in actions(snap)
+
+    def test_high_stall_drops_depth(self):
+        snap = SignalSnapshot(stall_ms=CFG.stall_high_ms + 1, depth=4)
+        ds = decide(snap, CFG)
+        assert [d.action for d in ds] == ["depth-down"]
+        assert ds[0].value == 3
+
+    def test_high_stall_at_min_depth_holds(self):
+        snap = SignalSnapshot(stall_ms=CFG.stall_high_ms + 1, depth=1)
+        assert actions(snap) == []
+
+    def test_drained_releases_backpressure(self):
+        snap = SignalSnapshot(rate_fraction=0.5, backlog=0, depth=2)
+        assert actions(snap) == ["backpressure-release"]
+
+    def test_saturated_idle_device_deepens_window(self):
+        snap = SignalSnapshot(
+            saturation=0.9, stall_ms=0.0, depth=2,
+            rate_fraction=1.0,
+        )
+        ds = decide(snap, CFG)
+        assert [d.action for d in ds] == ["depth-up"]
+        assert ds[0].value == 3
+
+    def test_saturation_at_max_depth_escalates_to_rescale(self):
+        snap = SignalSnapshot(
+            saturation=0.9, stall_ms=0.0, depth=CFG.max_depth,
+            rate_fraction=1.0, replicas=1,
+        )
+        assert "rescale-up" in actions(snap)
+
+    def test_sustained_lag_rescales_up(self):
+        snap = SignalSnapshot(
+            source_lag_ms=CFG.lag_high_ms + 1, depth=2, replicas=1,
+        )
+        ds = [d for d in decide(snap, CFG) if d.action == "rescale-up"]
+        assert ds and ds[0].value == 2
+
+    def test_never_scales_while_load_shedding(self):
+        """rate_fraction < 1 means backpressure is engaged — adding
+        replicas while deliberately shedding load would fight itself."""
+        snap = SignalSnapshot(
+            source_lag_ms=CFG.lag_high_ms + 1, depth=2, replicas=1,
+            rate_fraction=0.5,
+        )
+        assert "rescale-up" not in actions(snap)
+
+    def test_rescale_capped_at_max_replicas(self):
+        snap = SignalSnapshot(
+            source_lag_ms=CFG.lag_high_ms + 1, depth=2,
+            replicas=CFG.max_replicas,
+        )
+        assert "rescale-up" not in actions(snap)
+
+    def test_lag_drained_rescales_down(self):
+        snap = SignalSnapshot(replicas=3, source_lag_ms=0.0, depth=2)
+        ds = [d for d in decide(snap, CFG) if d.action == "rescale-down"]
+        assert ds and ds[0].value == 2
+
+    def test_decide_is_pure(self):
+        """Same snapshot, same decisions — the replay contract."""
+        snap = SignalSnapshot(
+            stall_ms=900.0, backlog=3.0, depth=4, replicas=2,
+        )
+        a = [(d.rule, d.action, d.value) for d in decide(snap, CFG)]
+        b = [(d.rule, d.action, d.value) for d in decide(snap, CFG)]
+        assert a == b
+
+    def test_every_decided_action_is_a_known_kind(self):
+        """The table can only speak the shared actuation vocabulary."""
+        crisis = SignalSnapshot(
+            stall_ms=9999.0, backlog=99.0, source_lag_ms=1e9,
+            saturation=1.0, malformed_ratio=1.0, depth=4, replicas=2,
+            rate_fraction=0.5,
+        )
+        for d in decide(crisis, CFG):
+            assert d.action in ACTION_KINDS
+
+
+# ---------------------------------------------------------------------------
+# controller: budget, cooldown, no-flap
+# ---------------------------------------------------------------------------
+def _controller(cfg=None, **kw):
+    cfg = cfg or PilotConfig(window_s=1.0, cooldown_s=10.0, budget=2)
+    depth = {"d": 4}
+    ctl = PilotController(
+        cfg,
+        actuators=[
+            DepthActuator(
+                lambda: depth["d"],
+                lambda v: depth.update(d=v),
+                max_depth=cfg.max_depth,
+            ),
+        ],
+        **kw,
+    )
+    ctl._depth_probe = lambda: depth["d"]
+    return ctl, depth
+
+
+class TestControllerBounds:
+    def test_budget_caps_applied_actuations(self):
+        cfg = PilotConfig(budget=1, cooldown_s=0.0)
+        bucket = TokenBucket(base_rate=100.0)
+        depth = {"d": 4}
+        ctl = PilotController(cfg, bucket=bucket, actuators=[
+            DepthActuator(lambda: depth["d"], lambda v: depth.update(d=v)),
+            BackpressureActuator(bucket),
+        ])
+        snap = SignalSnapshot(
+            stall_ms=cfg.stall_high_ms + 1, backlog=cfg.backlog_high,
+            depth=4,
+        )
+        ds = ctl.apply(decide(snap, cfg), snap, now=100.0)
+        assert sum(d.applied for d in ds) == 1
+        assert [d.suppressed for d in ds if not d.applied] == ["budget"]
+        assert ctl.actuations_count == 1
+        assert ctl.suppressed_count == 1
+
+    def test_cooldown_suppresses_within_family(self):
+        cfg = PilotConfig(budget=4, cooldown_s=10.0)
+        ctl, depth = _controller(cfg)
+        snap = SignalSnapshot(stall_ms=cfg.stall_high_ms + 1, depth=4)
+        ds1 = ctl.apply(decide(snap, cfg), snap, now=100.0)
+        assert ds1[0].applied and depth["d"] == 3
+        snap2 = SignalSnapshot(stall_ms=cfg.stall_high_ms + 1, depth=3)
+        ds2 = ctl.apply(decide(snap2, cfg), snap2, now=105.0)  # < 10s later
+        assert not ds2[0].applied and ds2[0].suppressed == "cooldown"
+        assert depth["d"] == 3
+        ds3 = ctl.apply(decide(snap2, cfg), snap2, now=111.0)  # elapsed
+        assert ds3[0].applied and depth["d"] == 2
+
+    def test_direction_flip_waits_doubled_cooldown(self):
+        cfg = PilotConfig(budget=4, cooldown_s=10.0)
+        ctl, depth = _controller(cfg)
+        down = SignalSnapshot(stall_ms=cfg.stall_high_ms + 1, depth=4)
+        ctl.apply(decide(down, cfg), down, now=100.0)
+        assert depth["d"] == 3
+        up = SignalSnapshot(saturation=1.0, stall_ms=0.0, depth=3)
+        # ordinary cooldown elapsed, flip cooldown (2x) has not
+        ds = ctl.apply(decide(up, cfg), up, now=112.0)
+        assert not ds[0].applied and ds[0].suppressed == "cooldown"
+        ds = ctl.apply(decide(up, cfg), up, now=121.0)
+        assert ds[0].applied and depth["d"] == 4
+
+    def test_no_flap_under_oscillating_signal(self):
+        """The no-flap property: a signal oscillating between
+        stall-high and saturated-idle every window must not drag depth
+        up and down with it — direction flips are separated by at
+        least the doubled cooldown, so at most one flip lands per
+        2*cooldown_s."""
+        cfg = PilotConfig(budget=4, cooldown_s=10.0, window_s=1.0)
+        ctl, depth = _controller(cfg)
+        changes = []
+        t = 100.0
+        for i in range(40):  # 40 windows, signal flips every window
+            if i % 2 == 0:
+                snap = SignalSnapshot(
+                    stall_ms=cfg.stall_high_ms + 1, depth=depth["d"],
+                )
+            else:
+                snap = SignalSnapshot(
+                    saturation=1.0, stall_ms=0.0, depth=depth["d"],
+                )
+            before = depth["d"]
+            ctl.apply(decide(snap, cfg), snap, now=t)
+            if depth["d"] != before:
+                changes.append((t, depth["d"] - before))
+            t += cfg.window_s
+        flips = [
+            (t2, d2) for (t1, d1), (t2, d2) in zip(changes, changes[1:])
+            if (d1 > 0) != (d2 > 0)
+        ]
+        for (t1, _), (t2, _) in zip(changes, changes[1:]):
+            assert t2 - t1 >= cfg.cooldown_s
+        for t1, _ in flips:
+            prev = max(t for t, _ in changes if t < t1)
+            assert t1 - prev >= 2.0 * cfg.cooldown_s
+        # and the loop does not amplify: 40 oscillations, few changes
+        assert len(changes) <= 4
+
+    def test_noop_apply_spends_no_budget(self):
+        cfg = PilotConfig(budget=1, cooldown_s=0.0)
+        ctl, depth = _controller(cfg)
+        depth["d"] = 1
+        # decision targets the current depth -> actuator reports no-op
+        snap = SignalSnapshot(depth=1)
+        ds = ctl.apply(
+            [Decision(rule="synthetic", action="depth-down", value=1)],
+            snap, now=100.0,
+        )
+        assert not ds[0].applied and ds[0].suppressed == "noop"
+        assert ctl.actuations_count == 0
+
+    def test_unactuated_kind_is_marked(self):
+        ctl, _ = _controller()
+        snap = SignalSnapshot()
+        ds = ctl.apply(
+            [Decision(rule="synthetic", action="rescale-up", value=2)],
+            snap, now=100.0,
+        )
+        assert ds[0].suppressed == "unactuated"
+
+    def test_tick_arms_then_respects_window(self):
+        cfg = PilotConfig(window_s=5.0, cooldown_s=0.0)
+        ctl, _ = _controller(cfg)
+        now = [100.0]
+        ctl.now = lambda: now[0]
+        assert ctl.tick() is None          # first tick only arms
+        now[0] += 2.0
+        assert ctl.tick() is None          # window not elapsed
+        now[0] += 4.0
+        assert ctl.tick() is not None      # 6s > window_s
+
+
+# ---------------------------------------------------------------------------
+# actuators
+# ---------------------------------------------------------------------------
+class TestActuators:
+    def test_depth_actuator_clamps(self):
+        depth = {"d": 4}
+        act = DepthActuator(
+            lambda: depth["d"], lambda v: depth.update(d=v),
+            min_depth=1, max_depth=4,
+        )
+        d = Decision(rule="r", action="depth-up", value=99)
+        assert act.apply(d) is False  # clamped to 4 == current: no-op
+        d = Decision(rule="r", action="depth-down", value=-3)
+        assert act.apply(d) is True
+        assert depth["d"] == 1 and d.value == 1
+
+    def test_scale_actuator_records_rejection(self):
+        class RejectingOps:
+            def rescale(self, name, n):
+                raise RuntimeError("DX400 oversubscribed")
+
+        act = ScaleActuator(RejectingOps(), "job", max_replicas=4)
+        d = Decision(rule="r", action="rescale-up", value=2)
+        assert act.apply(d) is False
+        assert "DX400" in d.suppressed
+
+    def test_scale_actuator_applies_through_job_ops(self):
+        from data_accelerator_tpu.pilot.chaos import RecordingRescaler
+
+        ops = RecordingRescaler()
+        act = ScaleActuator(ops, "job", max_replicas=3)
+        d = Decision(rule="r", action="rescale-up", value=9)
+        assert act.apply(d) is True
+        assert ops.calls == [3]  # clamped to max_replicas
+        assert d.value == 3      # live record count
+
+
+# ---------------------------------------------------------------------------
+# token bucket
+# ---------------------------------------------------------------------------
+class TestTokenBucket:
+    def test_rejects_nonpositive_rate(self):
+        with pytest.raises(ValueError):
+            TokenBucket(base_rate=0)
+
+    def test_passthrough_until_engaged(self):
+        b = TokenBucket(base_rate=100.0)
+        assert not b.engaged
+        assert b.rate_fraction() == 1.0
+
+    def test_throttle_floors_and_clamps_tokens(self):
+        b = TokenBucket(base_rate=100.0, min_fraction=0.125)
+        for _ in range(10):
+            b.throttle()
+        assert b.rate == pytest.approx(12.5)
+        assert b.engaged
+        # stored tokens clamped down with the rate (no stale burst);
+        # the wall-clock refill between calls stays sub-token
+        assert b.tokens() <= b.rate + 1.0
+
+    def test_take_grants_at_least_one(self):
+        b = TokenBucket(base_rate=100.0, now_fn=lambda: 0.0)
+        b.throttle(1e-9)
+        assert b.take(50) >= 1  # flow must keep moving to see drains
+
+    def test_take_is_metered_by_refill(self):
+        t = {"now": 0.0}
+        b = TokenBucket(base_rate=100.0, now_fn=lambda: t["now"])
+        b.throttle()  # rate 50/s, tokens clamped to 50
+        assert b.take(1000) == 50
+        t["now"] += 1.0  # one second refills 50
+        assert b.take(1000) == 50
+
+    def test_recover_returns_to_base(self):
+        b = TokenBucket(base_rate=100.0)
+        b.throttle()
+        b.throttle()
+        b.recover()
+        b.recover()
+        b.recover()
+        assert b.rate == 100.0 and not b.engaged
+
+
+# ---------------------------------------------------------------------------
+# conf plumbing
+# ---------------------------------------------------------------------------
+class TestConf:
+    def test_config_parses_flat_conf_keys(self):
+        from data_accelerator_tpu.core.config import SettingDictionary
+
+        sub = SettingDictionary({
+            "windowseconds": "2.5", "cooldownseconds": "30",
+            "budget": "3", "maxdepth": "6", "stallhighms": "750",
+            "maxreplicas": "8",
+        })
+        cfg = PilotConfig.from_setting_dictionary(sub)
+        assert cfg.enabled
+        assert cfg.window_s == 2.5
+        assert cfg.cooldown_s == 30.0
+        assert cfg.budget == 3
+        assert cfg.max_depth == 6
+        assert cfg.stall_high_ms == 750.0
+        assert cfg.max_replicas == 8
+
+    def test_config_disabled(self):
+        from data_accelerator_tpu.core.config import SettingDictionary
+
+        sub = SettingDictionary({"enabled": "false"})
+        assert not PilotConfig.from_setting_dictionary(sub).enabled
+
+    def test_stall_ewma_half_life_conf(self):
+        """Satellite: observability.stallewmams is a half-life in ms of
+        batch time — after one half-life of batches a level shift
+        covers half the distance; absent, the legacy alpha applies."""
+        from data_accelerator_tpu.obs.exposition import HealthState
+
+        legacy = HealthState(flow="f", batch_interval_s=1.0)
+        assert legacy.stall_ewma_alpha == HealthState.STALL_EWMA_ALPHA
+
+        h = HealthState(
+            flow="f", batch_interval_s=1.0,
+            stall_ewma_half_life_ms=1000.0,  # one batch per half-life
+        )
+        assert h.stall_ewma_alpha == pytest.approx(0.5)
+        h.record_stall(100.0)  # first sample seeds the gauge
+        assert h.pipeline_stall_ms == pytest.approx(100.0)
+        h.record_stall(0.0)    # one half-life covers half the distance
+        assert h.pipeline_stall_ms == pytest.approx(50.0)
+        h.record_stall(0.0)
+        assert h.pipeline_stall_ms == pytest.approx(25.0)
+
+    def test_snapshot_props_round_trip(self):
+        snap = SignalSnapshot(
+            now=12.5, stall_ms=300.125, backlog=2.0, depth=3,
+            alert_actions=("backpressure",), replicas=2,
+        )
+        back = SignalSnapshot.from_props(
+            json.loads(json.dumps(snap.to_props()))
+        )
+        assert back.stall_ms == pytest.approx(snap.stall_ms)
+        assert back.depth == 3
+        assert back.alert_actions == ("backpressure",)
+        # unknown props are ignored, not fatal (forward compat)
+        assert SignalSnapshot.from_props({"depth": 2, "novel": 1}).depth == 2
+
+
+# ---------------------------------------------------------------------------
+# alert rule action field (satellite)
+# ---------------------------------------------------------------------------
+class TestAlertActionField:
+    def test_validate_rejects_unknown_action(self):
+        from data_accelerator_tpu.obs.alerts import validate_rules
+
+        errs = validate_rules([{
+            "name": "r", "metric": "m", "op": ">", "threshold": 1,
+            "action": "self-destruct",
+        }])
+        assert errs and "'action'" in errs[0]
+
+    def test_validate_accepts_pilot_vocabulary(self):
+        from data_accelerator_tpu.obs.alerts import validate_rules
+
+        for action in ACTION_KINDS:
+            assert validate_rules([{
+                "name": "r", "metric": "m", "op": ">", "threshold": 1,
+                "action": action,
+            }]) == []
+
+    def test_default_backlog_rule_votes_backpressure(self):
+        from data_accelerator_tpu.obs.alerts import (
+            default_rules,
+            validate_rules,
+        )
+
+        rules = default_rules("AnyFlow")
+        assert validate_rules(rules) == []
+        [backlog] = [
+            r for r in rules if r["name"] == "background-transfer-backlog"
+        ]
+        assert backlog["action"] == "backpressure"
+
+    def test_firing_rule_action_reaches_snapshot(self):
+        """A firing rule's action lands in SignalSnapshot.alert_actions
+        — the wire from the alert engine into the decision table."""
+        import time
+
+        from data_accelerator_tpu.obs.alerts import AlertEngine
+        from data_accelerator_tpu.obs.store import MetricStore
+
+        store = MetricStore()
+        engine = AlertEngine(
+            [{
+                "name": "hot", "metric": "X", "op": ">", "threshold": 1.0,
+                "action": "backpressure", "windowSeconds": 60,
+            }],
+            flow="F", store=store,
+        )
+        store.add_point("DATAX-F:X", int(time.time() * 1000), 5.0)
+        engine.evaluate()
+        ctl = PilotController(PilotConfig(), flow="F", alerts=engine)
+        snap = ctl.read_signals(now=0.0)
+        assert snap.alert_actions == ("backpressure",)
+        assert "backpressure" in actions(snap)
+
+
+# ---------------------------------------------------------------------------
+# replay CLI (satellite)
+# ---------------------------------------------------------------------------
+def _write_trace(path, records):
+    with open(path, "w", encoding="utf-8") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+class TestReplayCli:
+    def _evaluate_span(self, now, **props):
+        base = SignalSnapshot(now=now).to_props()
+        base.update(props)
+        return {
+            "type": "span", "name": "pilot/evaluate",
+            "trace": "t", "span": "s", "parent": None,
+            "startTs": now, "durationMs": 0.1, "properties": base,
+        }
+
+    def test_replay_recorded_snapshots(self, tmp_path, capsys):
+        from data_accelerator_tpu.pilot.__main__ import main
+
+        trace = tmp_path / "trace.jsonl"
+        _write_trace(trace, [
+            {"type": "event", "name": "noise"},
+            self._evaluate_span(100.0, stall_ms=900.0, depth=4),
+            self._evaluate_span(200.0, stall_ms=10.0, depth=3,
+                                saturation=1.0),
+        ])
+        assert main(["--replay", str(trace)]) == 0
+        out = capsys.readouterr().out
+        assert "2 evaluation window(s) (recorded snapshots)" in out
+        assert "stall-high-depth-down" in out
+        assert "saturated-depth-up" in out
+        assert "2 actuation(s)" in out
+
+    def test_replay_json_and_knob_overrides(self, tmp_path, capsys):
+        """--cooldown override changes the verdict — the 'would a
+        longer cooldown have prevented that flap?' debugging story."""
+        from data_accelerator_tpu.pilot.__main__ import main
+
+        trace = tmp_path / "trace.jsonl"
+        _write_trace(trace, [
+            self._evaluate_span(100.0, stall_ms=900.0, depth=4),
+            self._evaluate_span(130.0, stall_ms=10.0, depth=3,
+                                saturation=1.0),
+        ])
+        assert main(["--replay", str(trace), "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["snapshots"] == "recorded"
+        assert doc["actuations"] == 2
+
+        # a 60s cooldown holds the reversal (flip cooldown = 120s > 30s)
+        assert main([
+            "--replay", str(trace), "--json", "--cooldown", "60",
+        ]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["actuations"] == 1
+        held = doc["evaluations"][1]["decisions"][0]
+        assert held["suppressed"] == "cooldown"
+
+    def test_replay_reconstructs_from_sync_spans(self, tmp_path, capsys):
+        """A pilot-off recording has no pilot/evaluate spans; the CLI
+        rebuilds coarse stall snapshots from batch sync spans and says
+        so."""
+        from data_accelerator_tpu.pilot.__main__ import main
+
+        trace = tmp_path / "trace.jsonl"
+        _write_trace(trace, [
+            {"type": "span", "name": "sync", "startTs": 100.0 + i,
+             "durationMs": 800.0, "properties": {}}
+            for i in range(12)
+        ])
+        assert main(["--replay", str(trace)]) == 0
+        out = capsys.readouterr().out
+        assert "reconstructed snapshots" in out
+
+    def test_unknown_flag_exits_2(self, capsys):
+        from data_accelerator_tpu.pilot.__main__ import main
+
+        assert main(["--repaly", "x.jsonl"]) == 2
+        assert main([]) == 2
+
+    def test_missing_file_exits_1(self, capsys):
+        from data_accelerator_tpu.pilot.__main__ import main
+
+        assert main(["--replay", "/nonexistent/trace.jsonl"]) == 1

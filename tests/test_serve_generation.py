@@ -374,3 +374,47 @@ class TestJobRegistry:
         assert [j["name"] for j in reg.get_all()] == ["j1"]
         reg.delete("j1")
         assert reg.get("j1") is None
+
+
+class TestPilotGeneration:
+    def test_pilot_jobconfig_flows_to_conf(self, stores):
+        """Designer jobPilot* knobs land as datax.job.process.pilot.*
+        (generation S640); jobStallEwmaMs rides along as the shared
+        observability.stallewmams constant so /readyz and the pilot
+        judge "stalled" off one conf'd half-life."""
+        design, runtime = stores
+        gui = make_gui("PilotConf")
+        gui["process"]["jobconfig"].update({
+            "jobPilotWindowSeconds": "2.5",
+            "jobPilotBudget": "3",
+            "jobPilotMaxDepth": "6",
+            "jobStallEwmaMs": "1500",
+        })
+        design.save(FlowConfigBuilder().build(gui))
+        res = RuntimeConfigGeneration(design, runtime).generate("PilotConf")
+        assert res.ok, res.errors
+        conf = dict(
+            line.split("=", 1)
+            for line in open(res.conf_paths[0]).read().splitlines()
+            if "=" in line
+        )
+        assert conf["datax.job.process.pilot.windowseconds"] == "2.5"
+        assert conf["datax.job.process.pilot.budget"] == "3"
+        assert conf["datax.job.process.pilot.maxdepth"] == "6"
+        assert conf["datax.job.process.observability.stallewmams"] == "1500"
+        # default ON: no enabled key is emitted unless opted out
+        assert "datax.job.process.pilot.enabled" not in conf
+
+    def test_pilot_opt_out(self, stores):
+        design, runtime = stores
+        gui = make_gui("NoPilot")
+        gui["process"]["jobconfig"]["jobPilot"] = "false"
+        design.save(FlowConfigBuilder().build(gui))
+        res = RuntimeConfigGeneration(design, runtime).generate("NoPilot")
+        assert res.ok, res.errors
+        conf = dict(
+            line.split("=", 1)
+            for line in open(res.conf_paths[0]).read().splitlines()
+            if "=" in line
+        )
+        assert conf["datax.job.process.pilot.enabled"] == "false"
