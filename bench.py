@@ -315,6 +315,40 @@ def hbm_model_check(proc):
     }
 
 
+def ici_model_check(proc):
+    """Cross-validate the DX7xx mesh-sharding model against the real
+    Mesh lowering (analysis/meshcheck.py) for the bench flow at the
+    8-chip MULTICHIP slice: the per-stage closed-form collective bytes
+    must equal the partitioner's output exactly (when this process has
+    >= 2 devices to lower against — the TPU tunnel exposes one, so the
+    model is recorded unvalidated there and tier-1 validates it on the
+    virtual CPU mesh). The OBSERVED side — the executed mesh program's
+    collective census vs this model, asserted within the DX51x
+    tolerance — lives in the MULTICHIP capture
+    (``__graft_entry__.dryrun_multichip``), which actually runs the
+    sharded step."""
+    from data_accelerator_tpu.analysis import analyze_processor_mesh
+    from data_accelerator_tpu.obs.conformance import DEFAULT_ICI_RATIO_HIGH
+
+    report = analyze_processor_mesh(proc, chips=8)
+    t = report.totals()
+    mismatched = [
+        s.name for s in report.stages
+        if s.lowered_bytes is not None
+        and s.lowered_bytes != s.ici_result_bytes
+    ]
+    return {
+        "chips": 8,
+        "model_ici_wire_bytes_per_batch": t["iciWireBytesPerBatch"],
+        "model_ici_result_bytes_per_batch": t["iciResultBytesPerBatch"],
+        "reshard_count": t["reshardCount"],
+        "per_chip_hbm_bytes": t["perChipHbmBytes"],
+        "validated_against_lowering": report.validated,
+        "model_equals_lowering": report.validated and not mismatched,
+        "dx51x_tolerance": DEFAULT_ICI_RATIO_HIGH,
+    }
+
+
 def measure_device_step(proc, payloads, base_ms, sync_rtt_ms, k=16):
     """Per-batch device compute, amortized: enqueue K steps back-to-back
     and sync ONCE, so the tunnel round trip is paid once for K batches
@@ -703,6 +737,7 @@ def main():
         "batch_capacity": capacity,
         "bench_context": bench_context(dec_rows_s),
         "hbm_model": hbm_model_check(proc),
+        "ici_model": ici_model_check(proc),
         "cold_start": bench_cold_start(),
         "pilot": bench_pilot_overhead(),
     }

@@ -23,11 +23,18 @@ Five tiers:
   entry point the flow will ever dispatch, lower each over
   ``jax.eval_shape`` avals, prove the signature set finite and stable
   — the DX6xx lints — and emit the AOT **compile manifest** the
-  runtime warms from at init (``compilecheck.py``).
+  runtime warms from at init (``compilecheck.py``);
+- the mesh tier (``analyze_flow_mesh``): infer the flow's SPMD
+  partition plan from the planner lowering — per-stage shard axis,
+  forced reshard edges, closed-form collective bytes over chips N —
+  cross-checked exactly against a real ``Mesh`` lowering, with the
+  DX7xx lints and the sharding-plan artifact mesh jobs' confs embed
+  for runtime ICI-drift conformance (``meshcheck.py``).
 
 CLI: ``python -m data_accelerator_tpu.analysis flow.json [--json]
 [--device [--chips N]] [--udfs] [--fleet [--fleet-spec=spec.json]]
-[--compile [--manifest=m.json] [--manifest-out=m.json]] [--all]``
+[--compile [--manifest=m.json] [--manifest-out=m.json]]
+[--mesh [--chips N]] [--all]``
 (non-zero exit on error-severity diagnostics, optional tiers included
 when requested; ``--all`` runs every tier in one invocation).
 """
@@ -75,6 +82,15 @@ from .fleetcheck import (
     load_fleet_spec,
     pack_fleet,
 )
+from .chipcount import ChipCountError, parse_chip_count
+from .meshcheck import (
+    DEFAULT_MESH_CHIPS,
+    MeshPlanReport,
+    MeshStage,
+    ReshardEdge,
+    analyze_flow_mesh,
+    analyze_processor_mesh,
+)
 from .typeprop import TableScope, schema_to_types
 from .udfcheck import (
     UdfCheckReport,
@@ -86,13 +102,18 @@ from .udfcheck import (
 __all__ = [
     "AnalysisReport",
     "CODES",
+    "ChipCountError",
     "CompileSurfaceReport",
     "MANIFEST_VERSION",
     "DEFAULT_CHIPS",
     "DEFAULT_FLEET_CHIPS",
+    "DEFAULT_MESH_CHIPS",
     "DEFAULT_MAX_STATE_ROWS",
     "DevicePlanReport",
     "Diagnostic",
+    "MeshPlanReport",
+    "MeshStage",
+    "ReshardEdge",
     "FleetReport",
     "FleetSpec",
     "FlowAnalyzer",
@@ -113,10 +134,13 @@ __all__ = [
     "analyze_flow",
     "analyze_flow_compile",
     "analyze_flow_device",
+    "analyze_flow_mesh",
     "analyze_flow_udfs",
     "analyze_processor",
     "analyze_processor_compile",
+    "analyze_processor_mesh",
     "analyze_script",
+    "parse_chip_count",
     "check_udf_object",
     "combined_report_dict",
     "flow_footprint",

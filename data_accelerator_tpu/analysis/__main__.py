@@ -4,7 +4,7 @@
         [--json] [--device] [--chips=N] [--udfs]
         [--fleet] [--fleet-spec=spec.json]
         [--compile] [--manifest=m.json] [--manifest-out=m.json]
-        [--all]
+        [--mesh] [--all]
 
 Each argument is a flow config file: either a designer gui JSON or a
 full flow document (``{"gui": {...}}``). Prints one line per diagnostic
@@ -48,10 +48,20 @@ is emitted (in ``--json`` under ``compile.manifest``;
 for drift against the fresh lowering (DX602 donation mismatch, DX603
 aval/digest drift). Same exit contract.
 
+``--mesh`` runs the mesh-sharding tier (``analysis/meshcheck.py``):
+the flow's static SPMD partition plan — per-stage shard axis, forced
+reshard edges, closed-form collective bytes — with the DX7xx lints,
+cross-checked EXACTLY against a real ``Mesh``+``NamedSharding``
+lowering (the CLI virtualizes CPU devices for the check when the
+backend has fewer than the requested chips). ``--chips=N`` sets the
+mesh size (default 8, the MULTICHIP slice); the one ``--chips`` flag
+feeds the device tier's ICI model and the mesh tier alike, and a
+non-positive or non-integer value exits 2. Same exit contract.
+
 ``--all`` runs every tier in one invocation (semantic + device + udfs
-+ fleet + compile) with one merged ``--json`` report (single
++ fleet + compile + mesh) with one merged ``--json`` report (single
 ``schemaVersion``, combined diagnostics, same 0/1/2 exit contract) —
-one CI call instead of five flags.
+one CI call instead of six flags.
 
 Unknown ``--`` flags are rejected with exit 2 (a typo like ``--devcie``
 must not silently skip a tier and report a false clean pass).
@@ -110,6 +120,29 @@ def _print_device_plan(path: str, device) -> None:
         print(line)
 
 
+def _print_mesh_plan(path: str, mesh) -> None:
+    t = mesh.totals()
+    state = "validated" if mesh.validated else "UNVALIDATED"
+    print(
+        f"{path}: mesh plan ({mesh.chips} chips, {state}): "
+        f"{len(mesh.stages)} stage(s), "
+        f"ICI {_fmt_bytes(t['iciWireBytesPerBatch'])}/batch wire "
+        f"({_fmt_bytes(t['iciResultBytesPerBatch'])} result, "
+        f"{t['reshardCount']} reshard(s)), "
+        f"per-chip HBM {_fmt_bytes(t['perChipHbmBytes'])}"
+    )
+    for s in mesh.stages:
+        line = (
+            f"{path}:   [{s.kind}] {s.name} axis={s.axis} rows={s.rows} "
+            f"per-chip={_fmt_bytes(s.per_chip_bytes)}"
+        )
+        if s.ici_wire_bytes:
+            line += f" ici={_fmt_bytes(s.ici_wire_bytes)}"
+        if s.detail:
+            line += f" ({s.detail})"
+        print(line)
+
+
 def _print_fleet_plan(fleet) -> None:
     spec = fleet.spec
     plan = fleet.placement
@@ -139,7 +172,7 @@ def _print_fleet_plan(fleet) -> None:
 # flags the CLI understands; anything else --prefixed is a usage error
 # (a typo like --devcie must not silently skip a tier)
 KNOWN_FLAGS = {"--json", "--device", "--udfs", "--fleet", "--compile",
-               "--all"}
+               "--mesh", "--all"}
 KNOWN_VALUE_FLAGS = ("--chips=", "--fleet-spec=", "--manifest=",
                      "--manifest-out=")
 
@@ -154,6 +187,7 @@ def main(argv: List[str]) -> int:
     udf_tier = "--udfs" in argv or all_tiers
     fleet_tier = "--fleet" in argv or all_tiers
     compile_tier = "--compile" in argv or all_tiers
+    mesh_tier = "--mesh" in argv or all_tiers
     chips: Optional[int] = None
     fleet_spec_path: Optional[str] = None
     manifest_path: Optional[str] = None
@@ -164,10 +198,15 @@ def main(argv: List[str]) -> int:
         if a in KNOWN_FLAGS:
             continue
         if a.startswith("--chips="):
+            # one shared, typed chip-count parser for every tier that
+            # consumes N (device ICI model, mesh plan, fleet spec) — a
+            # --chips=0 typo exits 2 instead of modeling nothing
+            from .chipcount import ChipCountError, parse_chip_count
+
             try:
-                chips = int(a.split("=", 1)[1])
-            except ValueError:
-                print(f"invalid --chips value: {a}", file=sys.stderr)
+                chips = parse_chip_count(a.split("=", 1)[1], "--chips")
+            except ChipCountError as e:
+                print(str(e), file=sys.stderr)
                 return 2
         elif a.startswith("--fleet-spec="):
             fleet_spec_path = a.split("=", 1)[1]
@@ -187,10 +226,24 @@ def main(argv: List[str]) -> int:
         print("--manifest-out accepts a single flow", file=sys.stderr)
         return 2
 
+    if mesh_tier and "xla_force_host_platform_device_count" not in (
+        os.environ.get("XLA_FLAGS", "")
+    ):
+        # the mesh cross-check lowers under a real Mesh: virtualize
+        # enough CPU devices (capped — result bytes are N-independent,
+        # so an 8-device check validates any --chips). Must happen
+        # before the first jax import below.
+        n = min(chips or 8, 8)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
     from .analyzer import analyze_flow
     from .compilecheck import analyze_flow_compile
     from .deviceplan import analyze_flow_device, combined_report_dict
     from .diagnostics import REPORT_SCHEMA_VERSION
+    from .meshcheck import analyze_flow_mesh
     from .udfcheck import analyze_flow_udfs
 
     shipped_manifest = None
@@ -236,6 +289,7 @@ def main(argv: List[str]) -> int:
             analyze_flow_compile(flow, manifest=shipped_manifest)
             if compile_tier else None
         )
+        mesh = analyze_flow_mesh(flow, chips=chips) if mesh_tier else None
         any_errors |= not report.ok
         if device is not None:
             any_errors |= not device.ok
@@ -246,12 +300,18 @@ def main(argv: List[str]) -> int:
             if manifest_out and comp.manifest is not None:
                 with open(manifest_out, "w", encoding="utf-8") as f:
                     json.dump(comp.manifest, f, indent=1)
+        if mesh is not None:
+            any_errors |= not mesh.ok
         if as_json:
-            if device is not None or udfs is not None or comp is not None:
+            if (
+                device is not None or udfs is not None
+                or comp is not None or mesh is not None
+            ):
                 json_out.append({
                     "file": path,
                     **combined_report_dict(
-                        report, device, udfs, compile_surface=comp
+                        report, device, udfs, compile_surface=comp,
+                        mesh=mesh,
                     ),
                 })
             else:
@@ -261,7 +321,7 @@ def main(argv: List[str]) -> int:
                 list(device.diagnostics) if device is not None else []
             ) + (list(udfs.diagnostics) if udfs is not None else []) + (
                 list(comp.diagnostics) if comp is not None else []
-            )
+            ) + (list(mesh.diagnostics) if mesh is not None else [])
             for d in diags:
                 print(f"{path}: {d.render()}")
             n_e = len([d for d in diags if d.is_error])
@@ -286,6 +346,8 @@ def main(argv: List[str]) -> int:
                     f"{'stable' if cd['stable'] else 'OPEN'}, "
                     f"jit-cache cap {cd['jitCacheCap']}"
                 )
+            if mesh is not None and mesh.stages:
+                _print_mesh_plan(path, mesh)
 
     fleet = None
     if fleet_tier:

@@ -149,6 +149,96 @@ def runtime_conformance_model(
     }
 
 
+# ---------------------------------------------------------------------------
+# Mesh collective wire-cost closed forms (the DX7xx tier,
+# analysis/meshcheck.py). Two byte conventions, deliberately separate:
+#
+# - **result bytes**: the full logical size of a collective's result —
+#   chip-count-INDEPENDENT, deterministic from static shapes, and the
+#   quantity the analyzer asserts exactly equal between the closed-form
+#   model and the Mesh-lowered program (the DX2xx `model ==
+#   materialized` analog).
+# - **wire bytes**: total bytes crossing ICI links across the whole
+#   slice for a ring-algorithm collective over `result bytes` — the
+#   Megatron-LM closed forms over chip count N. This is the term DX703
+#   budgets and the runtime's Mesh_ICI_Bytes series observes.
+#
+# ring all-gather of S result bytes: each chip forwards (N-1) shard
+# messages of S/N bytes -> total S*(N-1). ring all-reduce =
+# reduce-scatter + all-gather -> 2*S*(N-1)/N per chip, total 2*S*(N-1).
+# all-to-all keeps 1/N local -> total S*(N-1)/N.
+# ---------------------------------------------------------------------------
+def allgather_wire_bytes(result_bytes: float, chips: int) -> float:
+    """Total slice-wide ICI bytes of a ring all-gather producing
+    ``result_bytes`` on every chip."""
+    if chips <= 1:
+        return 0.0
+    return float(result_bytes) * (chips - 1)
+
+
+def allreduce_wire_bytes(result_bytes: float, chips: int) -> float:
+    """Total slice-wide ICI bytes of a ring all-reduce (reduce-scatter
+    + all-gather) over ``result_bytes``."""
+    if chips <= 1:
+        return 0.0
+    return 2.0 * float(result_bytes) * (chips - 1)
+
+
+def alltoall_wire_bytes(result_bytes: float, chips: int) -> float:
+    """Total slice-wide ICI bytes of an all-to-all over
+    ``result_bytes`` (1/N of every shard stays local)."""
+    if chips <= 1:
+        return 0.0
+    return float(result_bytes) * (chips - 1) / chips
+
+
+# wire factor per compiled-HLO collective op name — the same convention
+# dist/mesh.py's runtime collective_summary applies, so the model and
+# the observed Mesh_ICI_Bytes series can never disagree about what a
+# byte over the ICI means
+COLLECTIVE_WIRE_FACTORS = {
+    "all-gather": allgather_wire_bytes,
+    "all-reduce": allreduce_wire_bytes,
+    "reduce-scatter": alltoall_wire_bytes,  # S*(N-1)/N: one shard stays
+    "all-to-all": alltoall_wire_bytes,
+    "collective-permute": lambda s, n: float(s),  # every byte moves once
+}
+
+
+def collective_wire_bytes(op: str, result_bytes: float, chips: int) -> float:
+    """Wire bytes of one collective given its result bytes — shared by
+    the DX7xx model and the runtime observation path."""
+    fn = COLLECTIVE_WIRE_FACTORS.get(op)
+    return fn(result_bytes, chips) if fn else float(result_bytes)
+
+
+def mesh_runtime_model(
+    totals: Dict[str, object], stages: Optional[list] = None,
+) -> dict:
+    """The sharding plan as a *runtime artifact*: the compact JSON slice
+    of a mesh-plan report that config generation embeds into mesh jobs'
+    confs (``datax.job.process.mesh.model``, the S660 stage) and the
+    host's ``ConformanceMonitor`` judges the observed ``Mesh_ICI_Bytes``
+    / ``Mesh_Reshard_Count`` series against (DX510/DX511)."""
+    return {
+        "totals": {
+            "iciResultBytesPerBatch": totals.get("iciResultBytesPerBatch"),
+            "iciWireBytesPerBatch": totals.get("iciWireBytesPerBatch"),
+            "reshardCount": totals.get("reshardCount"),
+            "chips": totals.get("chips"),
+        },
+        "stages": [
+            {
+                "name": s.get("name"),
+                "axis": s.get("axis"),
+                "iciWireBytes": s.get("iciWireBytes"),
+                "reshards": s.get("reshards"),
+            }
+            for s in (stages or [])
+        ],
+    }
+
+
 def _log2(n: int) -> float:
     return math.log2(max(int(n), 2))
 

@@ -236,17 +236,18 @@ def _ordered(diags: List[Diagnostic]) -> List[Diagnostic]:
 
 def combined_report_dict(
     base: AnalysisReport, device: Optional[DevicePlanReport] = None,
-    udfs=None, fleet=None, compile_surface=None,
+    udfs=None, fleet=None, compile_surface=None, mesh=None,
 ) -> dict:
-    """Merge the semantic tier with the optional device, UDF, fleet and
-    compile tiers into one response: a superset of
+    """Merge the semantic tier with the optional device, UDF, fleet,
+    compile and mesh tiers into one response: a superset of
     ``AnalysisReport.to_dict()`` plus a ``device`` cost report, a
-    ``udfs`` summary, a ``fleet`` placement plan and/or a ``compile``
-    surface+manifest — what ``flow/validate`` returns with ``device:
-    true`` / ``udfs: true`` / ``fleet: true`` / ``compile: true`` (or
-    ``all: true``) and what the CLI's tier flags (or ``--all``)
-    ``--json`` print: one ``schemaVersion``, one merged diagnostics
-    list, one exit contract."""
+    ``udfs`` summary, a ``fleet`` placement plan, a ``compile``
+    surface+manifest and/or a ``mesh`` sharding plan — what
+    ``flow/validate`` returns with ``device: true`` / ``udfs: true`` /
+    ``fleet: true`` / ``compile: true`` / ``mesh: true`` (or ``all:
+    true``) and what the CLI's tier flags (or ``--all``) ``--json``
+    print: one ``schemaVersion``, one merged diagnostics list, one exit
+    contract."""
     from .diagnostics import REPORT_SCHEMA_VERSION
 
     diags = list(base.diagnostics)
@@ -258,6 +259,8 @@ def combined_report_dict(
         diags += list(fleet.diagnostics)
     if compile_surface is not None:
         diags += list(compile_surface.diagnostics)
+    if mesh is not None:
+        diags += list(mesh.diagnostics)
     diags = _ordered(diags)
     errors = [d for d in diags if d.is_error]
     out = {
@@ -275,6 +278,8 @@ def combined_report_dict(
         out["fleet"] = fleet.fleet_dict()
     if compile_surface is not None:
         out["compile"] = compile_surface.compile_dict()
+    if mesh is not None:
+        out["mesh"] = mesh.mesh_dict()
     return out
 
 

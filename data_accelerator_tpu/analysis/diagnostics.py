@@ -178,6 +178,26 @@ CODES: Dict[str, tuple] = {
               "derive grid/BlockSpec from static shapes only and always pass out_shape=jax.ShapeDtypeStruct(...)"),
     "DX310": (SEV_ERROR, "UDF conf entry does not load: bad package.module:attr, non-callable target, or aggregate without reduce",
               "point class/module at an importable UDF object or zero-arg factory; aggregates must provide reduce"),
+    # -- pass 10: mesh sharding (analysis/meshcheck.py, the --mesh
+    #    tier: static SPMD partition plan over the compiled views —
+    #    per-stage shard axis, reshard edges, collective byte model
+    #    cross-checked exactly against the Mesh lowering) -------------
+    "DX700": (SEV_WARNING, "unshardable stage forces full replication: a global ORDER BY (device or host-side) or a Pallas-kernel UDF call materializes every row on every chip, so the stage gains nothing from more chips",
+              "drop the ORDER BY (sinks can sort), push it behind a GROUP BY that shrinks the rows, or rewrite the kernel UDF in jax.numpy so GSPMD can shard it"),
+    "DX701": (SEV_WARNING, "resharding between adjacent stages: the same sharded table is gathered onto every chip at two or more stage boundaries, paying the all-gather repeatedly",
+              "fold the consumers into one statement, or materialize a shared intermediate view so the gather happens once"),
+    "DX702": (SEV_ERROR, "per-chip shard exceeds chip HBM at the requested chip count: the sharded residency plus replicated tables cannot fit one chip",
+              "add chips, shrink batch/window/group capacities, or provision chips with more HBM (fleet-spec hbmPerChipBytes)"),
+    "DX703": (SEV_WARNING, "predicted ICI bytes/batch exceed the fleet-spec interconnect budget at the batch interval: collectives will dominate the step",
+              "group/join on lower-cardinality keys, shrink output capacities, or raise the spec's iciBytesPerSecPerChip deliberately"),
+    "DX704": (SEV_WARNING, "scaling cliff: the stage's modeled per-chip cost is flat or worse in the chip count (replicated compute at batch scale, or collective wire growth outpacing the compute shrink)",
+              "reshape the stage so rows stay sharded (shard-friendly keys, no full-capacity replication), or stop adding chips past the cliff"),
+    "DX705": (SEV_WARNING, "sized output transfer and donated output slots auto-disable under a mesh: every output fetch moves the full padded capacity and no background double-buffering applies",
+              "expect full-capacity D2H under the mesh, or keep the flow single-chip until the sharded sized-transfer path exists"),
+    "DX790": (SEV_ERROR, "mesh lowering failed or disagrees with the sharding model: the partition plan's closed-form collective bytes do not match what the SPMD partitioner emitted",
+              "fix the statement per the lowering error, or regenerate after engine changes — the byte model must match the lowering exactly"),
+    "DX791": (SEV_WARNING, "mesh analysis unavailable or unvalidated: no concrete input schema, or fewer than two devices to lower the partition plan against",
+              "inline the input schema JSON; run under a multi-device backend (the CLI virtualizes CPU devices) to validate the model"),
     # -- pass 9: compile surface (analysis/compilecheck.py, the
     #    --compile tier: enumerate every jit entry point, lower each
     #    over eval_shape avals, prove the signature set finite and
@@ -211,6 +231,8 @@ PASS_NAMES = {
     "DX41": "fleet interference",
     "DX60": "compile surface",
     "DX69": "compile surface",
+    "DX70": "mesh sharding",
+    "DX79": "mesh sharding",
 }
 
 # version of every ``--json`` report shape the analysis tiers emit (the
@@ -218,7 +240,8 @@ PASS_NAMES = {
 # when top-level keys change so downstream consumers (designer,
 # admission gate, CI tooling) can detect report-format drift; a tier-1
 # test pins the current key sets against this number.
-REPORT_SCHEMA_VERSION = 1
+# v2: the ``mesh`` report block (the --mesh tier's sharding plan).
+REPORT_SCHEMA_VERSION = 2
 
 
 def make(code: str, table: str, message: str, span: Optional[Span] = None,

@@ -89,8 +89,15 @@ class FleetSpec:
     @classmethod
     def from_dict(cls, d: dict) -> "FleetSpec":
         spec = cls()
+        # the chips key funnels through the one shared chip-count
+        # parser every surface uses (analysis/chipcount.py) — a typed
+        # ChipCountError (a ValueError) on non-positive/non-integer N
+        from .chipcount import parse_chip_count
+
+        chips = parse_chip_count(d.get("chips"), "fleet spec 'chips'")
+        if chips is not None:
+            spec.chips = chips
         mapping = {
-            "chips": ("chips", int),
             "hbmPerChipBytes": ("hbm_per_chip_bytes", int),
             "headroomFraction": ("headroom_fraction", float),
             "d2hBytesPerSecPerChip": ("d2h_bytes_per_sec_per_chip", float),
@@ -100,8 +107,6 @@ class FleetSpec:
         for key, (attr, conv) in mapping.items():
             if d.get(key) is not None:
                 setattr(spec, attr, conv(d[key]))
-        if spec.chips < 1:
-            raise ValueError("fleet spec needs at least 1 chip")
         return spec
 
     def to_dict(self) -> dict:

@@ -285,6 +285,11 @@ class ExprCompiler:
         self.scope = scope
         self.dictionary = dictionary
         self.udfs = udfs or {}
+        # UDF objects this compiler's expressions actually called — the
+        # select compiler attributes them to the view's StagePlan so
+        # the mesh partition planner knows which stages embed custom
+        # kernels the SPMD partitioner cannot shard
+        self.called_udfs: list = []
         # dictionary-table registry for device string ops; shared across
         # every compiler of one flow (see compile/stringops.py)
         self.aux = aux if aux is not None else AuxRegistry()
@@ -1092,7 +1097,9 @@ class ExprCompiler:
         # UDF tiers
         lowered = name.lower()
         if lowered in self.udfs:
-            return self.udfs[lowered].compile_call(self, e)
+            obj = self.udfs[lowered]
+            self.called_udfs.append(obj)
+            return obj.compile_call(self, e)
 
         raise EngineException(f"unknown function {name}")
 

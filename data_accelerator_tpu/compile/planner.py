@@ -169,6 +169,14 @@ class StagePlan:
     kind: str  # "project" | "group" | "union"
     input_rows: int  # FROM-scope capacity feeding the select
     output_rows: int  # final output capacity (post ORDER/LIMIT)
+    # table names the FROM chain reads (base + join right sides; union:
+    # all branches' sources) — the mesh partition planner
+    # (analysis/meshcheck.py) walks these to find reshard edges
+    sources: Tuple[str, ...] = ()
+    # names of Pallas-kernel UDFs the view's expressions call: a custom
+    # call has no SPMD partitioning rule, so the partitioner replicates
+    # the stage — the mesh planner must model it as a replication origin
+    unshardable_udfs: Tuple[str, ...] = ()
     joins: Tuple[JoinSite, ...] = ()
     grouped: bool = False
     group_keys: int = 0
@@ -321,16 +329,38 @@ class SelectCompiler:
         from .stringops import AuxRegistry
 
         self.aux = aux if aux is not None else AuxRegistry()
+        # every expression compiler built while compiling the current
+        # view — compile_select drains it to attribute UDF calls to the
+        # view's StagePlan (see StagePlan.unshardable_udfs)
+        self._view_expr_compilers: List[ExprCompiler] = []
 
     def _expr_compiler(self, scope: Scope) -> ExprCompiler:
-        return ExprCompiler(scope, self.dictionary, self.udfs, aux=self.aux)
+        ec = ExprCompiler(scope, self.dictionary, self.udfs, aux=self.aux)
+        self._view_expr_compilers.append(ec)
+        return ec
 
     # -- entry -----------------------------------------------------------
     def compile_select(self, name: str, sel: Select) -> CompiledView:
+        mark = len(self._view_expr_compilers)
         if sel.union is not None:
             view = self._compile_union(name, sel)
         else:
             view = self._compile_single(name, sel)
+        # attribute the UDF calls compiled for this view (union: all
+        # branches) to its plan; only Pallas kernels matter — a custom
+        # call cannot be SPMD-partitioned, so the mesh planner treats
+        # the stage as a replication origin
+        called = [
+            u for ec in self._view_expr_compilers[mark:]
+            for u in ec.called_udfs
+        ]
+        del self._view_expr_compilers[mark:]
+        pallas = tuple(sorted({
+            str(getattr(u, "name", type(u).__name__))
+            for u in called if hasattr(u, "kernel")
+        }))
+        if pallas and view.plan is not None:
+            view.plan = replace(view.plan, unshardable_udfs=pallas)
         return view
 
     @staticmethod
@@ -387,6 +417,9 @@ class SelectCompiler:
                     for c in compiled
                 ),
                 output_rows=capacity,
+                sources=tuple(dict.fromkeys(
+                    s for c in compiled if c.plan for s in c.plan.sources
+                )),
                 joins=tuple(
                     s for c in compiled if c.plan for s in c.plan.joins
                 ),
@@ -404,8 +437,12 @@ class SelectCompiler:
 
         # 1. FROM/JOIN scope
         scope, build_scope, scope_capacity, join_sites = self._compile_from(sel)
+        from_tables = tuple(dict.fromkeys(
+            [sel.from_table.name] + [j.table.name for j in sel.joins]
+        ))
 
         compiler = _AggCollector(scope, self.dictionary, self.udfs, aux=self.aux)
+        self._view_expr_compilers.append(compiler)
 
         # 2. WHERE
         where_fn = None
@@ -438,7 +475,7 @@ class SelectCompiler:
                 name, sel, scope, compiler, build_scope, scope_capacity,
                 where_fn, out_types, deferred, flat_outputs, out_values,
                 having_fn=having_c.fn if having_c is not None else None,
-                join_sites=join_sites,
+                join_sites=join_sites, from_tables=from_tables,
             )
             view.select_values = out_values
             if sel.order_by or sel.limit is not None:
@@ -490,6 +527,7 @@ class SelectCompiler:
                 kind="project",
                 input_rows=scope_capacity,
                 output_rows=scope_capacity,
+                sources=from_tables,
                 joins=tuple(join_sites),
                 distinct=bool(sel.distinct),
             ),
@@ -1182,7 +1220,7 @@ class SelectCompiler:
     def _compile_grouped(
         self, name, sel, scope, compiler, build_scope, scope_capacity,
         where_fn, out_types, deferred, flat_outputs, out_values,
-        having_fn=None, join_sites=(),
+        having_fn=None, join_sites=(), from_tables=(),
     ) -> CompiledView:
         # group keys: resolve against select aliases first, then scope
         alias_map = {}
@@ -1358,6 +1396,7 @@ class SelectCompiler:
                 kind="group",
                 input_rows=scope_capacity,
                 output_rows=capacity,
+                sources=tuple(from_tables),
                 joins=tuple(join_sites),
                 grouped=True,
                 group_keys=len(key_compiled),

@@ -127,11 +127,21 @@ class MetricName:
         # rebuilds + shape/dictionary-growth cache misses); the
         # conformance monitor's DX503 input
         r"Retrace_Count",
+        # observed mesh communication (dist/mesh.py collective_summary,
+        # exported by the mesh processor per batch): ring-convention
+        # wire bytes of the executed program's collectives and its
+        # collective-op count — the runtime counterpart of the DX7xx
+        # sharding model, judged by the DX510/DX511 conformance checks
+        r"Mesh_ICI_Bytes",
+        r"Mesh_Reshard_Count",
         # model-vs-observed conformance (obs/conformance.py): windowed
         # observed/predicted ratios against the cost-model report
         # embedded in the conf, plus the cumulative drift-event count
         r"Conformance_D2HBytes_Ratio",
         r"Conformance_Occupancy_[A-Za-z0-9_.]+_Ratio",
+        # mesh ICI drift ratio (observed Mesh_ICI_Bytes / the embedded
+        # sharding model's wire prediction — the DX510 gauge)
+        r"Conformance_MeshIci_Ratio",
         r"Conformance_Drift_Count",
         # AOT compile + persistent compilation cache
         # (runtime/processor.py process.compile.*): init-time warm cost,

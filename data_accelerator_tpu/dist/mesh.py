@@ -25,7 +25,9 @@ host-level communication code.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -86,3 +88,106 @@ def step_shardings(mesh: Mesh):
     in_shardings = (row, ring, rep, rep, rep, rep, rep, rep, rep)
     out_shardings = (rep, ring, rep, rep)
     return in_shardings, out_shardings
+
+
+# ---------------------------------------------------------------------------
+# Observed collective communication: what the SPMD partitioner actually
+# put on the ICI.
+#
+# GSPMD inserts the collectives during compilation (the StableHLO the
+# tracer produces is still logical), so the ground truth for "how many
+# bytes does this program move over the interconnect per batch" is the
+# compiled module's HLO text. `collective_summary` parses it into a
+# typed per-op-kind byte census. Two consumers, one convention:
+#
+# - the runtime (`FlowProcessor` under a mesh) summarizes its own
+#   compiled step and exports the census per batch as the
+#   `Mesh_ICI_Bytes` / `Mesh_Reshard_Count` registry series — the real
+#   observation the DX51x conformance ratios judge;
+# - the DX7xx mesh analyzer (`analysis/meshcheck.py`) summarizes its
+#   per-stage lowerings and asserts the closed-form model equals the
+#   extraction exactly.
+#
+# Byte convention: `result_bytes` per collective = the full logical
+# size of the op's result (chip-count-independent; the exactness
+# contract's unit). Wire bytes apply the ring closed forms
+# (`analysis/costmodel.py collective_wire_bytes`) per op kind.
+# ---------------------------------------------------------------------------
+
+# compiled-HLO scalar type -> bytes (everything this engine lowers is
+# 32-bit except bool; wider types listed for robustness)
+_HLO_DTYPE_BYTES: Dict[str, int] = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+}
+
+_COLLECTIVE_RE = re.compile(
+    r"=\s*((?:\()?[a-z0-9\[\],{}\s]*?)\s*"
+    r"(all-reduce|all-gather|all-to-all|collective-permute|reduce-scatter)"
+    r"(?:-start|-done)?\("
+)
+_SHAPE_RE = re.compile(r"([a-z]+[0-9]*)\[([0-9,]*)\]")
+
+
+@dataclass
+class MeshCollectives:
+    """Census of the collective ops in one compiled SPMD program."""
+
+    # op kind -> (instruction count, total result bytes)
+    ops: Dict[str, Tuple[int, int]] = field(default_factory=dict)
+
+    @property
+    def op_count(self) -> int:
+        return sum(c for c, _b in self.ops.values())
+
+    @property
+    def result_bytes(self) -> int:
+        return sum(b for _c, b in self.ops.values())
+
+    def wire_bytes(self, chips: int) -> float:
+        """Total slice-wide ICI bytes per execution under the ring
+        closed forms (the Mesh_ICI_Bytes unit)."""
+        from ..analysis.costmodel import collective_wire_bytes
+
+        return sum(
+            collective_wire_bytes(op, b, chips)
+            for op, (_c, b) in self.ops.items()
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            op: {"count": c, "resultBytes": b}
+            for op, (c, b) in sorted(self.ops.items())
+        }
+
+
+def collective_summary(compiled_hlo_text: str) -> MeshCollectives:
+    """Parse a compiled module's HLO text into a collective census.
+
+    Counts every all-reduce / all-gather / all-to-all /
+    collective-permute / reduce-scatter instruction (async start/done
+    pairs count once, on the start) and sums each instruction's result
+    shape bytes."""
+    ops: Dict[str, Tuple[int, int]] = {}
+    for m in _COLLECTIVE_RE.finditer(compiled_hlo_text):
+        shapes, op = m.group(1), m.group(2)
+        # async form: -done repeats the -start result; count the start
+        if m.group(0).rstrip("(").endswith("-done"):
+            continue
+        total = 0
+        for sm in _SHAPE_RE.finditer(shapes):
+            dt, dims = sm.group(1), sm.group(2)
+            n_el = 1
+            for d in dims.split(","):
+                if d:
+                    n_el *= int(d)
+            total += n_el * _HLO_DTYPE_BYTES.get(dt, 4)
+        c, b = ops.get(op, (0, 0))
+        ops[op] = (c + 1, b + total)
+    return MeshCollectives(ops)
+
+
+def summarize_compiled(compiled) -> MeshCollectives:
+    """Census of a ``jax`` compiled executable (``lowered.compile()``
+    result)."""
+    return collective_summary(compiled.as_text())

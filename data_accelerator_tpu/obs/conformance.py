@@ -21,6 +21,20 @@ counts — against those predictions and exports:
   | DX501 | d2h-bytes-drift | windowed observed D2H bytes exceed the modeled per-batch transfer by more than the tolerance band |
   | DX502 | occupancy-vs-modeled-cardinality | an output's observed row occupancy exceeds the modeled group/join cardinality — the capacity planning input was wrong |
   | DX503 | unmodeled-retrace | the jitted step re-traced after warmup; steady state is modeled as trace-free |
+  | DX510 | ici-bytes-drift | windowed observed mesh collective bytes (``Mesh_ICI_Bytes``) exceed the DX7xx sharding model's wire prediction by more than the tolerance band |
+  | DX511 | mesh-collective-count-drift | the executed mesh program's collective-op census (``Mesh_Reshard_Count``) changed from its post-warmup baseline — a re-trace repartitioned the step |
+
+The DX51x pair is the runtime half of the mesh tier
+(``analysis/meshcheck.py``): config generation embeds the sharding
+plan's collective model into mesh jobs' confs
+(``datax.job.process.mesh.model``, the S660 stage), the mesh processor
+censuses its own compiled program's collectives per batch
+(``dist/mesh.py collective_summary`` -> ``Mesh_ICI_Bytes`` /
+``Mesh_Reshard_Count``), and this monitor judges one against the
+other. The model charges the planned-layout gathers; the partitioner
+is free to do better (or trade all-gathers for all-reduce chains), so
+the DX510 band is wider than DX501's — it catches the model *missing*
+traffic wholesale, not micro-divergence.
 
 Events fire on the *transition* into drift (and re-arm on recovery), so
 a sustained drift is one event, not one per batch; the cumulative
@@ -54,12 +68,24 @@ DRIFT_CODES: Dict[str, str] = {
     "DX501": "d2h-bytes-drift",
     "DX502": "occupancy-vs-modeled-cardinality",
     "DX503": "unmodeled-retrace",
+    "DX510": "ici-bytes-drift",
+    "DX511": "mesh-collective-count-drift",
 }
 
 # observed/predicted ratio above which DX501 fires (sized transfer makes
 # observed < predicted the healthy direction; exceeding the model means
 # the model missed traffic)
 DEFAULT_D2H_RATIO_HIGH = 1.5
+# observed/predicted ratio above which DX510 fires. The DX7xx model
+# prices the planned-layout gathers; GSPMD legitimately trades them for
+# partial-aggregation all-reduce chains whose ring wire cost runs up to
+# ~4x the gather model on the join-heavy MULTICHIP flow (measured; the
+# dryrun asserts it), so the band is much wider than DX501's — it
+# catches wholesale model misses (an unmodeled reshard storm, a
+# dictionary-growth retrace multiplying the collective census), not
+# partitioner microstructure. DX511's count-drift check is the sharp
+# instrument for repartitioning.
+DEFAULT_ICI_RATIO_HIGH = 8.0
 # observed rows / modeled cardinality above which DX502 fires
 DEFAULT_OCCUPANCY_FACTOR = 2.0
 # windowed samples required before ratios are judged (and before a
@@ -106,16 +132,35 @@ class ConformanceModel:
     outputs: Dict[str, dict] = field(default_factory=dict)
     # per-stage d2hBytes (informational; the CLI/SPA render it)
     stages: List[dict] = field(default_factory=list)
+    # mesh sharding-plan predictions (datax.job.process.mesh.model, the
+    # DX7xx analyzer's runtime artifact): modeled collective wire bytes
+    # per batch and the planned reshard count — the DX510/DX511 inputs
+    ici_wire_bytes_per_batch: Optional[float] = None
+    reshard_count: Optional[float] = None
 
     @classmethod
-    def from_json(cls, text: str) -> Optional["ConformanceModel"]:
-        try:
-            obj = json.loads(text)
-        except ValueError:
-            logger.warning("unparseable conformance model; monitor off")
+    def from_json(
+        cls, text: str, mesh_text: Optional[str] = None,
+    ) -> Optional["ConformanceModel"]:
+        obj: Optional[dict] = None
+        if text:
+            try:
+                parsed = json.loads(text)
+                obj = parsed if isinstance(parsed, dict) else None
+            except ValueError:
+                logger.warning("unparseable conformance model; monitor off")
+                obj = None
+        mesh_totals: dict = {}
+        if mesh_text:
+            try:
+                mesh_obj = json.loads(mesh_text)
+                if isinstance(mesh_obj, dict):
+                    mesh_totals = mesh_obj.get("totals") or {}
+            except ValueError:
+                logger.warning("unparseable mesh model; DX51x checks off")
+        if obj is None and not mesh_totals:
             return None
-        if not isinstance(obj, dict):
-            return None
+        obj = obj or {}
         totals = obj.get("totals") or {}
         return cls(
             d2h_bytes_per_batch=totals.get("d2hBytesPerBatch"),
@@ -125,6 +170,8 @@ class ConformanceModel:
                 if isinstance(v, dict)
             },
             stages=list(obj.get("stages") or []),
+            ici_wire_bytes_per_batch=mesh_totals.get("iciWireBytesPerBatch"),
+            reshard_count=mesh_totals.get("reshardCount"),
         )
 
     @classmethod
@@ -132,9 +179,12 @@ class ConformanceModel:
         raw = dict_.get_sub_dictionary(
             "datax.job.process.conformance."
         ).get("model")
-        if not raw:
+        mesh_raw = dict_.get_sub_dictionary(
+            "datax.job.process.mesh."
+        ).get("model")
+        if not raw and not mesh_raw:
             return None
-        return cls.from_json(raw)
+        return cls.from_json(raw or "", mesh_raw)
 
 
 class ConformanceMonitor:
@@ -151,6 +201,7 @@ class ConformanceMonitor:
         warmup: int = DEFAULT_WARMUP_BATCHES,
         d2h_ratio_high: float = DEFAULT_D2H_RATIO_HIGH,
         occupancy_factor: float = DEFAULT_OCCUPANCY_FACTOR,
+        ici_ratio_high: float = DEFAULT_ICI_RATIO_HIGH,
     ):
         self.model = model
         self.flow = flow
@@ -158,9 +209,15 @@ class ConformanceMonitor:
         self.warmup = max(1, int(warmup))
         self.d2h_ratio_high = float(d2h_ratio_high)
         self.occupancy_factor = float(occupancy_factor)
+        self.ici_ratio_high = float(ici_ratio_high)
         self.batches = 0
         self.drift_count = 0
         self._d2h: deque = deque(maxlen=self.window)
+        self._ici: deque = deque(maxlen=self.window)
+        # the executed mesh program's first post-warmup collective-op
+        # count — DX511's self-baseline (a change means a re-trace
+        # repartitioned the step)
+        self._collective_baseline: Optional[float] = None
         self._occupancy: Dict[str, deque] = {}
         # codes (keyed per metric) currently in drift — events fire on
         # the transition in, re-arm on recovery
@@ -176,6 +233,7 @@ class ConformanceMonitor:
         warmup = sub.get_int_option("warmup")
         high = sub.get_double_option("d2hratiohigh")
         occ = sub.get_double_option("occupancyfactor")
+        ici = sub.get_double_option("iciratiohigh")
         return cls(
             model,
             flow=flow,
@@ -186,6 +244,9 @@ class ConformanceMonitor:
             ),
             occupancy_factor=(
                 occ if occ is not None else DEFAULT_OCCUPANCY_FACTOR
+            ),
+            ici_ratio_high=(
+                ici if ici is not None else DEFAULT_ICI_RATIO_HIGH
             ),
         )
 
@@ -261,6 +322,56 @@ class ConformanceMonitor:
                     f"modeled cardinality {rp:.0f} "
                     f"({r:.2f}x > {self.occupancy_factor}x) — re-check "
                     "declared key cardinality (DX200/DX202 inputs)",
+                ),
+            )
+            if ev:
+                events.append(ev)
+
+        # DX510: observed mesh collective bytes vs the sharding model's
+        # wire prediction (the DX7xx runtime counterpart)
+        ici = metrics.get("Mesh_ICI_Bytes")
+        predicted_ici = self.model.ici_wire_bytes_per_batch
+        if ici is not None and predicted_ici:
+            self._ici.append(float(ici))
+            mean = sum(self._ici) / len(self._ici)
+            ratio = mean / float(predicted_ici)
+            gauges["Conformance_MeshIci_Ratio"] = ratio
+            ev = self._transition(
+                "DX510", warmed and ratio > self.ici_ratio_high,
+                lambda: DriftEvent(
+                    "DX510", "Mesh_ICI_Bytes", mean,
+                    float(predicted_ici), ratio, batch_time_ms,
+                    f"windowed mesh collective bytes {mean:.0f} exceed "
+                    f"the sharding model's {float(predicted_ici):.0f}"
+                    f"/batch by {ratio:.2f}x (> {self.ici_ratio_high}x) "
+                    f"— the DX7xx partition plan missed traffic "
+                    f"(re-validate with --mesh)",
+                ),
+            )
+            if ev:
+                events.append(ev)
+
+        # DX511: the executed mesh program's collective-op census vs
+        # its own post-warmup baseline (a change = a re-trace
+        # repartitioned the step — the plan no longer describes it)
+        n_coll = metrics.get("Mesh_Reshard_Count")
+        if n_coll is not None:
+            if warmed and self._collective_baseline is None:
+                self._collective_baseline = float(n_coll)
+            base = self._collective_baseline
+            drifted = base is not None and float(n_coll) != base
+            ev = self._transition(
+                "DX511", drifted,
+                lambda: DriftEvent(
+                    "DX511", "Mesh_Reshard_Count", float(n_coll),
+                    base or 0.0,
+                    (float(n_coll) / base) if base else 0.0,
+                    batch_time_ms,
+                    f"mesh collective-op count changed "
+                    f"{base:.0f} -> {n_coll:.0f} after warmup — the "
+                    f"step re-traced into a different partition "
+                    f"(dictionary growth or UDF refresh under the "
+                    f"mesh; see DX204/DX600)",
                 ),
             )
             if ev:

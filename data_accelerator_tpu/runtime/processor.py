@@ -656,6 +656,23 @@ class FlowProcessor:
             (pipe_conf.get_or_else("outputslots", "true") or "").lower()
             != "false"
         ) and mesh is None
+        # observed mesh communication (datax.job.process.mesh.observe,
+        # default on): under a mesh the compiled step's collective
+        # census (dist/mesh.py collective_summary) exports per batch as
+        # Mesh_ICI_Bytes / Mesh_Reshard_Count — the real runtime
+        # counterpart the DX51x conformance ratios judge against the
+        # embedded sharding model (process.mesh.model). The census
+        # costs one extra lower+compile of the step (the persistent
+        # compilation cache makes it a deserialize when configured).
+        self.mesh_observe = (
+            (
+                process_conf.get_sub_dictionary("mesh.")
+                .get_or_else("observe", "true") or ""
+            ).lower() != "false"
+        ) and mesh is not None
+        # None = not yet censused; False = census failed (don't retry
+        # every batch); else a dist.mesh.MeshCollectives
+        self.mesh_collectives = None
         self._slots: Dict[Tuple[str, int], list] = {}
         self._slot_parity: Dict[str, int] = {}
         # serializes ring/state donation in dispatch against the
@@ -1663,6 +1680,25 @@ class FlowProcessor:
         self.retrace_count = 0
         return n
 
+    def refresh_mesh_collectives(self) -> None:
+        """(Re)census the compiled mesh step's collectives — the
+        observed side of the DX51x ICI conformance ratios. Called
+        lazily at first collect (the step has compiled by then, so
+        with a persistent compilation cache the extra ``compile()``
+        deserializes) and again after any re-trace (the new program
+        may partition differently — exactly what DX511 watches)."""
+        if self.mesh is None or not self.mesh_observe:
+            self.mesh_collectives = None
+            return
+        try:
+            from ..dist.mesh import summarize_compiled
+
+            lowered = self._step.lower(*self._step_input_avals())
+            self.mesh_collectives = summarize_compiled(lowered.compile())
+        except Exception as e:  # noqa: BLE001 — observability never fails a batch
+            logger.warning("mesh collective census unavailable: %s", e)
+            self.mesh_collectives = False  # don't retry every batch
+
     # -- AOT compile surface (the zero-cold-start path) --------------------
     def _source_raw_form(self, spec: SourceSpec) -> str:
         """The raw transfer form (and therefore trace signature) the
@@ -2299,6 +2335,17 @@ class PendingBatch:
         retraces = proc.drain_retraces()
         if retraces:
             metrics["Retrace_Count"] = float(retraces)
+        # observed mesh communication: the executed program's collective
+        # census as per-batch series (the DX510/DX511 inputs). A
+        # re-trace re-censuses — the new program may partition
+        # differently, which is precisely the drift DX511 detects.
+        if proc.mesh is not None and proc.mesh_observe:
+            if proc.mesh_collectives is None or retraces:
+                proc.refresh_mesh_collectives()
+            mc = proc.mesh_collectives
+            if mc:
+                metrics["Mesh_ICI_Bytes"] = mc.wire_bytes(proc.mesh.size)
+                metrics["Mesh_Reshard_Count"] = float(mc.op_count)
         # warm-start promise check (the DX604 input): the AOT warm left
         # the step's jit cache at _warm_step_mark; growth past it means
         # a dispatch compiled even though a warm start was promised

@@ -121,6 +121,18 @@ class FlowOperation:
 
         return analyze_flow_compile(flow, manifest=manifest)
 
+    def validate_flow_mesh(self, flow: dict, chips=None):
+        """The mesh tier of ``flow/validate`` (``mesh: true``): the
+        flow's static SPMD partition plan — per-stage shard axis,
+        reshard edges, closed-form collective bytes — with the DX7xx
+        lints, cross-checked against a real ``Mesh`` lowering when the
+        control plane has >= 2 devices (else the plan is emitted
+        unvalidated with DX791). Same implementation as the CLI's
+        ``--mesh``; no device executes."""
+        from ..analysis import analyze_flow_mesh
+
+        return analyze_flow_mesh(flow, chips=chips)
+
     def validate_flow_fleet(self, flow: dict, spec: Optional[dict] = None):
         """The fleet tier of ``flow/validate`` (``fleet: true``): the
         candidate flow is analyzed AS A SET with every currently

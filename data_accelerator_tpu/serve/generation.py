@@ -92,6 +92,7 @@ class RuntimeConfigGeneration:
             self._s620_conformance,
             self._s630_compile,
             self._s640_pilot,
+            self._s660_mesh,
             self._s650_flatten,
             self._s700_write_files,
             self._s800_jobs,
@@ -613,6 +614,52 @@ class RuntimeConfigGeneration:
                 keys[f"datax.job.process.{conf_key}"] = str(v)
         ctx["pilot_keys"] = keys
 
+    def _s660_mesh(self, ctx) -> None:
+        """Embed the flow's **sharding-plan artifact** into mesh jobs'
+        confs (``datax.job.process.mesh.model``): the DX7xx
+        mesh-sharding analyzer's per-stage collective byte model
+        (``analysis/meshcheck.py``), the prediction the host's
+        ``ConformanceMonitor`` compares against the observed
+        ``Mesh_ICI_Bytes`` / ``Mesh_Reshard_Count`` series at runtime
+        (DX510/DX511 ICI drift, beside S620's DX501-503 model).
+
+        Single-chip jobs skip it (no mesh, no collectives to model).
+        The analyzer runs model-only here (``lower=False`` — no
+        per-stage compiles on the deploy path; tier-1 proves the model
+        equals the lowering). Fail-open like S620/S630: an analyzer
+        error must not block deployment — the mesh job simply runs
+        without ICI conformance, like every mesh job did before this
+        layer existed. Opt out with designer jobconfig ``jobMeshModel:
+        "false"``."""
+        doc = ctx["doc"]
+        jobconf = (doc["gui"].get("process") or {}).get("jobconfig") or {}
+        ctx["mesh_json"] = None
+        chips_s = str(
+            jobconf.get("jobNumChips")
+            or jobconf.get("jobNumExecutors") or "1"
+        )
+        try:
+            chips = int(chips_s)
+        except ValueError:
+            chips = 1
+        if (
+            chips > 1
+            and str(jobconf.get("jobMeshModel", "")).lower() != "false"
+        ):
+            try:
+                from ..analysis import analyze_flow_mesh
+
+                report = analyze_flow_mesh(doc, chips=chips, lower=False)
+                if report.stages:
+                    ctx["mesh_json"] = json.dumps(
+                        report.runtime_model(), separators=(",", ":")
+                    )
+            except Exception as e:  # noqa: BLE001 — monitoring is optional
+                logger.warning(
+                    "mesh model generation failed for %s: %s",
+                    doc.get("name"), e,
+                )
+
     def _s650_flatten(self, ctx) -> None:
         """Flatten each resolved job config JSON to flat conf text
         (S650 ConfigFlattener.Flatten)."""
@@ -644,6 +691,8 @@ class RuntimeConfigGeneration:
             if ctx.get("alert_rules_json"):
                 extra["datax.job.process.alerts.rules"] = (
                     ctx["alert_rules_json"])
+            if ctx.get("mesh_json"):
+                extra["datax.job.process.mesh.model"] = ctx["mesh_json"]
             if ctx.get("compile_manifest_path"):
                 extra["datax.job.process.compile.manifest"] = (
                     ctx["compile_manifest_path"])

@@ -229,8 +229,13 @@ class DataXApi:
         the diagnostics plus a ``compile`` section carrying the AOT
         compile manifest; optional ``"compileManifest": {...}`` checks
         a previously emitted manifest for drift (DX602/DX603).
-        ``"all": true`` runs every tier in one call — one merged
-        report, one ``schemaVersion``, the CI single-invocation path."""
+        ``"mesh": true`` adds the mesh-sharding tier (the CLI's
+        ``--mesh``): DX7xx partition lints merged into the diagnostics
+        plus a ``mesh`` section carrying the sharding plan (stage ->
+        axis -> per-chip bytes -> ICI bytes); the same ``"chips": N``
+        body field sets the mesh size. ``"all": true`` runs every tier
+        in one call — one merged report, one ``schemaVersion``, the CI
+        single-invocation path."""
         flow = body.get("flow") or body.get("gui")
         if flow is None and (body.get("flowName") or body.get("name")) \
                 and not body.get("process") and not body.get("input"):
@@ -245,16 +250,26 @@ class DataXApi:
         want_udfs = all_tiers or body.get("udfs")
         want_fleet = all_tiers or body.get("fleet")
         want_compile = all_tiers or body.get("compile")
-        if not (want_device or want_udfs or want_fleet or want_compile):
+        want_mesh = all_tiers or body.get("mesh")
+        if not (want_device or want_udfs or want_fleet or want_compile
+                or want_mesh):
             return report.to_dict()
-        from ..analysis import combined_report_dict
+        from ..analysis import (
+            ChipCountError,
+            combined_report_dict,
+            parse_chip_count,
+        )
 
-        device = None
-        if want_device:
-            chips = body.get("chips")
-            device = self.flow_ops.validate_flow_device(
-                flow, chips=int(chips) if chips else None
-            )
+        # one shared, typed chip-count parser for the device ICI model
+        # and the mesh plan (the CLI's --chips counterpart)
+        try:
+            chips = parse_chip_count(body.get("chips"), '"chips"')
+        except ChipCountError as e:
+            raise ApiError(str(e))
+        device = (
+            self.flow_ops.validate_flow_device(flow, chips=chips)
+            if want_device else None
+        )
         udfs = (
             self.flow_ops.validate_flow_udfs(flow) if want_udfs else None
         )
@@ -270,8 +285,12 @@ class DataXApi:
             )
             if want_compile else None
         )
+        mesh = (
+            self.flow_ops.validate_flow_mesh(flow, chips=chips)
+            if want_mesh else None
+        )
         return combined_report_dict(
-            report, device, udfs, fleet, compile_surface=comp
+            report, device, udfs, fleet, compile_surface=comp, mesh=mesh
         )
 
     def _flow_generate(self, body, query):

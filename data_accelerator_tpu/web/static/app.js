@@ -214,6 +214,37 @@ route("#/flow/", async (view, hash) => {
                   "runtime re-traces surface as Retrace_Count)") +
       `, jit-cache cap ${c.jitCacheCap}`);
   };
+  const renderShardingTable = (m) => {
+    // mesh tier (flow/validate mesh: true): the static SPMD partition
+    // plan — stage -> shard axis -> per-chip bytes -> ICI bytes, with
+    // the modeled reshard points (the DX7xx surface). "validated"
+    // means every byte was asserted equal to a real Mesh lowering.
+    if (!m || !m.stages || !m.stages.length) return null;
+    const t = m.totals || {};
+    return h("div", { class: "cost sharding" },
+      h("div", { class: "muted" },
+        `mesh plan @ ${m.chips} chips — ` +
+        `ICI ${fmtBytes(t.iciWireBytesPerBatch || 0)}/batch wire ` +
+        `(${t.reshardCount || 0} reshard(s)), ` +
+        `per-chip HBM ${fmtBytes(t.perChipHbmBytes || 0)} — ` +
+        (m.validated ? "model validated against the Mesh lowering"
+                     : "model UNVALIDATED (no multi-device backend)")),
+      h("table", { class: "grid cost-table sharding-table" },
+        h("thead", {}, h("tr", {},
+          h("th", {}, "stage"), h("th", {}, "kind"), h("th", {}, "axis"),
+          h("th", {}, "rows"), h("th", {}, "per-chip"),
+          h("th", {}, "ICI/batch"), h("th", {}, "reshards"))),
+        h("tbody", {}, m.stages.map((s) => h("tr", {},
+          h("td", { class: "mono" }, s.name),
+          h("td", {}, s.kind),
+          h("td", {}, s.axis),
+          h("td", { class: "num" }, fmtVal(s.rows)),
+          h("td", { class: "num" }, fmtBytes(s.perChipBytes || 0)),
+          h("td", { class: "num" },
+            s.iciWireBytes ? fmtBytes(s.iciWireBytes) : "–"),
+          h("td", { class: "mono" },
+            (s.reshards || []).map((e) => e.table).join(", ") || "–"))))));
+  };
   const renderDiags = (r) => {
     diagBox.replaceChildren(
       h("div", { class: "muted" },
@@ -227,6 +258,7 @@ route("#/flow/", async (view, hash) => {
       renderUdfSummary(r.udfs),
       renderCompileSurface(r.compile),
       renderCostTable(r.device),
+      renderShardingTable(r.mesh),
       renderPlacement(r.fleet));
   };
   const validate = async () => {

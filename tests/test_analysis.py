@@ -152,6 +152,7 @@ def test_golden_udf_diagnostic(fixture, code, severity):
 def test_every_registered_code_has_a_golden_fixture():
     from test_compilecheck import COMPILE_GOLDEN
     from test_fleetcheck import FLEET_GOLDEN
+    from test_meshcheck import MESH_GOLDEN
 
     assert (
         {g[1] for g in GOLDEN}
@@ -159,6 +160,7 @@ def test_every_registered_code_has_a_golden_fixture():
         | {g[1] for g in UDF_GOLDEN}
         | {g[2] for g in FLEET_GOLDEN}
         | {g[1] for g in COMPILE_GOLDEN}
+        | {g[1] for g in MESH_GOLDEN}
     ) == set(CODES)
 
 
@@ -401,6 +403,23 @@ def test_json_reports_pin_schema_version_and_keys(tmp_path):
     assert set(out["fleet"]) == {"spec", "flows", "placement"}
     assert set(out["fleet"]["placement"]) == {
         "feasible", "chips", "unplaced", "oversized", "unanalyzed"
+    }
+
+    # mesh tier (schemaVersion 2: the sharding-plan report block)
+    out = json.loads(_run_cli(["--json", "--mesh", path]).stdout)
+    assert out["schemaVersion"] == REPORT_SCHEMA_VERSION
+    assert set(out) == base_keys | {"file", "mesh"}
+    assert set(out["mesh"]) == {
+        "flow", "chips", "validated", "stages", "totals"
+    }
+    assert set(out["mesh"]["totals"]) == {
+        "iciResultBytesPerBatch", "iciWireBytesPerBatch", "reshardCount",
+        "perChipHbmBytes", "chips",
+    }
+    assert set(out["mesh"]["stages"][0]) == {
+        "name", "kind", "axis", "scaling", "rows", "hbmBytes",
+        "perChipBytes", "iciResultBytes", "iciWireBytes", "reshards",
+        "loweredBytes", "detail",
     }
 
 

@@ -133,6 +133,177 @@ def test_missing_predictions_disable_checks_silently():
     assert events == []
 
 
+# -- DX510/DX511: the mesh ICI drift pair (clean / drifting / missing
+#    model — the DX501 trio, applied to the sharding plan) ------------------
+
+def _mesh_model(wire=100_000.0, reshards=3.0):
+    return ConformanceModel(
+        ici_wire_bytes_per_batch=wire, reshard_count=reshards
+    )
+
+
+def test_clean_mesh_run_stays_silent():
+    mon = ConformanceMonitor(_mesh_model(wire=100_000.0), warmup=2, window=4)
+    all_events = []
+    for i in range(10):
+        gauges, events = mon.observe(
+            {"Mesh_ICI_Bytes": 120_000.0, "Mesh_Reshard_Count": 51.0}, i
+        )
+        all_events += events
+    # observed within the band (1.2x < the 8x default), constant census
+    assert all_events == []
+    assert gauges["Conformance_MeshIci_Ratio"] == pytest.approx(1.2)
+    assert "Conformance_Drift_Count" not in gauges
+
+
+def test_ici_drift_fires_dx510_once_and_rearms():
+    mon = ConformanceMonitor(
+        _mesh_model(wire=1_000.0), warmup=2, window=2, ici_ratio_high=8.0,
+    )
+    fired = []
+    for i in range(6):
+        _, events = mon.observe({"Mesh_ICI_Bytes": 50_000.0}, i)
+        fired += events
+    assert [e.code for e in fired] == ["DX510"]
+    ev = fired[0]
+    assert ev.metric == "Mesh_ICI_Bytes"
+    assert ev.ratio == pytest.approx(50.0)
+    assert ev.to_props()["name"] == "ici-bytes-drift"
+    assert "DX510" in DRIFT_CODES
+    # recovery re-arms, a new episode fires again
+    for i in range(6):
+        _, events = mon.observe({"Mesh_ICI_Bytes": 900.0}, 10 + i)
+        assert not events
+    _, evs = _run(mon, {"Mesh_ICI_Bytes": 90_000.0}, 6)
+    assert [e.code for e in evs] == ["DX510"]
+    assert mon.drift_count == 2
+
+
+def test_missing_mesh_model_disables_dx510_silently():
+    mon = ConformanceMonitor(_model(d2h=1000.0), warmup=1, window=4)
+    gauges, events = _run(
+        mon,
+        {"Transfer_D2HBytes": 950.0, "Mesh_ICI_Bytes": 1e12},
+        8,
+    )
+    assert events == []
+    assert "Conformance_MeshIci_Ratio" not in gauges
+
+
+def test_collective_count_drift_fires_dx511():
+    """DX511 self-baselines on the first post-warmup census: a mesh
+    re-trace that repartitions the step (different collective count)
+    fires once; a stable census never does."""
+    mon = ConformanceMonitor(_mesh_model(), warmup=2, window=4)
+    for i in range(5):
+        _, events = mon.observe({"Mesh_Reshard_Count": 51.0}, i)
+        assert not events
+    _, events = mon.observe({"Mesh_Reshard_Count": 80.0}, 6)
+    assert [e.code for e in events] == ["DX511"]
+    ev = events[0]
+    assert ev.to_props()["name"] == "mesh-collective-count-drift"
+    assert ev.observed == 80.0 and ev.predicted == 51.0
+    # back at the baseline: re-arms; another change fires again
+    mon.observe({"Mesh_Reshard_Count": 51.0}, 7)
+    _, events = mon.observe({"Mesh_Reshard_Count": 80.0}, 8)
+    assert [e.code for e in events] == ["DX511"]
+
+
+def test_mesh_model_parses_from_conf_beside_conformance_model():
+    from data_accelerator_tpu.core.config import SettingDictionary
+
+    mesh_json = json.dumps({
+        "totals": {"iciWireBytesPerBatch": 129024.0, "reshardCount": 3,
+                   "chips": 8},
+        "stages": [],
+    })
+    # mesh model alone arms the monitor (a mesh job may ship without a
+    # conformance model)
+    d = SettingDictionary({"datax.job.process.mesh.model": mesh_json})
+    m = ConformanceModel.from_conf(d)
+    assert m is not None
+    assert m.ici_wire_bytes_per_batch == 129024.0
+    assert m.reshard_count == 3
+    assert m.d2h_bytes_per_batch is None
+    assert ConformanceMonitor.from_conf(d) is not None
+    # both models merge into one
+    both = SettingDictionary({
+        "datax.job.process.mesh.model": mesh_json,
+        "datax.job.process.conformance.model": json.dumps(
+            {"totals": {"d2hBytesPerBatch": 4096}}
+        ),
+    })
+    m2 = ConformanceModel.from_conf(both)
+    assert m2.d2h_bytes_per_batch == 4096
+    assert m2.ici_wire_bytes_per_batch == 129024.0
+
+
+# -- runtime acceptance: a real 8-device mesh run ---------------------------
+
+@pytest.fixture
+def mesh_batch_metrics(tmp_path):
+    """One real batch's metric dict from a mesh-sharded FlowProcessor
+    (the 8-device virtual CPU mesh), plus its DX7xx sharding model."""
+    import jax.numpy as jnp
+
+    from test_dist import crafted_raw, make_conf
+
+    from data_accelerator_tpu.analysis import analyze_processor_mesh
+    from data_accelerator_tpu.compile.planner import TableData
+    from data_accelerator_tpu.dist import make_mesh
+    from data_accelerator_tpu.runtime.processor import FlowProcessor
+
+    proc = FlowProcessor(
+        make_conf(tmp_path), batch_capacity=256, mesh=make_mesh(8),
+        output_datasets=["Hot", "PerDevice"],
+    )
+    cols, valid = crafted_raw(proc)
+    raw = TableData(
+        {k: jnp.asarray(v) for k, v in cols.items()}, jnp.asarray(valid)
+    )
+    _, metrics = proc.process_batch(raw, batch_time_ms=1_700_000_000_000)
+    report = analyze_processor_mesh(proc, lower=False)
+    return metrics, report.runtime_model()
+
+
+def test_mesh_run_exports_collective_census(mesh_batch_metrics):
+    """Satellite: the mesh processor exports its executed program's
+    collective census as the Mesh_* registry series."""
+    from data_accelerator_tpu.constants import MetricName
+
+    metrics, _model = mesh_batch_metrics
+    assert metrics["Mesh_ICI_Bytes"] > 0
+    assert metrics["Mesh_Reshard_Count"] >= 1
+    assert MetricName.is_runtime_metric("Mesh_ICI_Bytes")
+    assert MetricName.is_runtime_metric("Mesh_Reshard_Count")
+    assert MetricName.is_runtime_metric("Conformance_MeshIci_Ratio")
+
+
+def test_dx510_fires_on_injected_drift_silent_on_clean_mesh_run(
+    mesh_batch_metrics,
+):
+    """Acceptance: the DX7xx model judged against a REAL mesh run stays
+    inside the DX51x band; a deliberately shrunken model (the injected
+    drift) fires DX510 exactly once."""
+    metrics, model_doc = mesh_batch_metrics
+    model = ConformanceModel.from_json("", json.dumps(model_doc))
+    assert model is not None and model.ici_wire_bytes_per_batch > 0
+
+    # clean: the real model vs the real observation
+    mon = ConformanceMonitor(model, warmup=1, window=4)
+    gauges, events = _run(mon, metrics, 8)
+    assert events == []
+    assert 0 < gauges["Conformance_MeshIci_Ratio"] < 8.0
+
+    # injected drift: claim the mesh should move ~10 bytes per batch
+    bad = ConformanceModel.from_json("", json.dumps({
+        "totals": {"iciWireBytesPerBatch": 10.0},
+    }))
+    mon2 = ConformanceMonitor(bad, warmup=1, window=4)
+    _, events = _run(mon2, metrics, 8)
+    assert [e.code for e in events] == ["DX510"]  # transition, not spam
+
+
 def test_model_parses_from_conf_and_rejects_garbage():
     from data_accelerator_tpu.core.config import SettingDictionary
 

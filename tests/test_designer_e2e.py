@@ -189,7 +189,8 @@ class TestDesignerE2E:
         for marker in (
             '"functions"', "AggregateRule", "_S_pivots", "_S_aggs",
             '"scale"', '"schedule"', "azureFunction", "Additional sources",
-            "renderCostTable", "renderCompileSurface", "all: true",
+            "renderCostTable", "renderCompileSurface",
+            "renderShardingTable", "all: true",
         ):
             assert marker in js, marker
 
