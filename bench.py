@@ -375,6 +375,55 @@ def measure_device_step(proc, payloads, base_ms, sync_rtt_ms, k=16):
     return max(0.0, (elapsed_ms - sync_rtt_ms) / k)
 
 
+def roofline_check(proc, observed_stage_ms):
+    """The time-model conformance block (PR 12): calibrate THIS
+    machine's profile (obs/calibrate.py — the same probes a streaming
+    host runs at init), price the flow's byte/FLOP closed forms into
+    per-stage roofline milliseconds (analysis/costmodel.py
+    latency_model), and put predicted vs observed side by side with the
+    drift ratio gated at the DX520 band. The roofline is a lower bound,
+    so ratios sit >= 1 by construction; `within_band` flipping false is
+    what a live host would fire DX520/DX521 on."""
+    from data_accelerator_tpu.analysis import analyze_processor
+    from data_accelerator_tpu.obs.calibrate import get_profile
+    from data_accelerator_tpu.obs.conformance import (
+        DEFAULT_STAGE_TIME_FLOOR_MS,
+        DEFAULT_STAGE_TIME_RATIO_HIGH,
+    )
+
+    profile = get_profile()
+    report = analyze_processor(proc, chips=16)
+    lm = report.latency_model(profile.to_dict(), source="calibrated")
+    stages = {}
+    for stage, pred_key in (
+        ("device-step", "deviceStepMs"), ("collect", "d2hMs"),
+    ):
+        predicted = (lm["totals"] or {}).get(pred_key)
+        observed = observed_stage_ms.get(stage)
+        if predicted is None or observed is None:
+            continue
+        ratio = observed / predicted if predicted else None
+        stages[stage] = {
+            "predicted_ms": round(predicted, 4),
+            "observed_ms": round(observed, 3),
+            "drift_ratio": round(ratio, 2) if ratio is not None else None,
+            # sub-floor predictions are not judged at runtime (host-side
+            # fixed costs dominate; obs/conformance.py DX520 floor)
+            "judged": predicted >= DEFAULT_STAGE_TIME_FLOOR_MS,
+            "within_band": (
+                predicted < DEFAULT_STAGE_TIME_FLOOR_MS
+                or ratio is None
+                or ratio <= DEFAULT_STAGE_TIME_RATIO_HIGH
+            ),
+        }
+    return {
+        "profile": profile.to_dict(),
+        "dx520_band": DEFAULT_STAGE_TIME_RATIO_HIGH,
+        "predicted_batch_ms": lm["totals"]["batchMs"],
+        "stages": stages,
+    }
+
+
 def bench_cold_start(capacity=None):
     """Zero-cold-start acceptance block: time-to-first-batch of the
     headline flow COLD (fresh processor, trace+compile paid at first
@@ -540,6 +589,22 @@ def regression_gate(current: dict, tolerance: float = 0.10):
         prev = doc.get("parsed") or doc
     except (OSError, ValueError):
         return None
+
+    # a trajectory only means something on one backend: a CPU one-box
+    # capture judged against an accelerator round (or vice versa) is
+    # environment, not code — record the mismatch instead of a verdict
+    prev_backend = prev.get("backend")
+    cur_backend = current.get("backend")
+    if prev_backend and cur_backend and prev_backend != cur_backend:
+        return {
+            "baseline": os.path.basename(latest),
+            "baseline_backend": prev_backend,
+            "backend": cur_backend,
+            "backend_mismatch": True,
+            "regressed": False,
+            "note": "baseline captured on a different backend; "
+                    "deltas not comparable",
+        }
 
     def delta(key):
         a, b = prev.get(key), current.get(key)
@@ -738,6 +803,12 @@ def main():
         "bench_context": bench_context(dec_rows_s),
         "hbm_model": hbm_model_check(proc),
         "ici_model": ici_model_check(proc),
+        # roofline vs the SEQUENTIAL latency loop's processor/stage
+        # medians — predicted and observed describe the same batch shape
+        "roofline": roofline_check(lproc, {
+            "device-step": device_step,
+            "collect": med["collect"],
+        }),
         "cold_start": bench_cold_start(),
         "pilot": bench_pilot_overhead(),
     }

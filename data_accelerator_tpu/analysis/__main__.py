@@ -104,6 +104,15 @@ def _print_device_plan(path: str, device) -> None:
         f"~{_fmt_count(totals['flops'])} FLOP/batch, "
         f"ICI {_fmt_bytes(totals['iciBytesPerBatch'])}/batch"
     )
+    lm = device.latency_model()
+    lt = lm["totals"]
+    ici = f" + ICI {lt['iciMs']:.3f} ms" if lt["iciMs"] else ""
+    print(
+        f"{path}: roofline latency ({lm['profileSource']} profile): "
+        f"device step {lt['deviceStepMs']:.3f} ms "
+        f"+ D2H {lt['d2hMs'] or 0:.3f} ms{ici} = "
+        f"{lt['batchMs']:.3f} ms/batch lower bound"
+    )
     for s in device.stages:
         line = (
             f"{path}:   [{s.kind}] {s.name} rows={s.rows} "

@@ -129,12 +129,16 @@ def runtime_conformance_model(
     host's ``ConformanceMonitor`` judges observations against. Keeps
     only what the monitor (and humans debugging drift) need — modeled
     per-batch D2H bytes, HBM totals, per-output modeled occupancy, and
-    the per-stage d2hBytes/hbmBytes breakdown."""
+    the per-stage d2hBytes/hbmBytes/flops breakdown (the byte+FLOP
+    terms the host combines with its own calibrated machine profile
+    into the DX520/DX521 latency predictions — bytes and FLOPs travel
+    in the conf, milliseconds are computed where the hardware is)."""
     return {
         "totals": {
             "d2hBytesPerBatch": totals.get("d2hBytesPerBatch"),
             "hbmBytes": totals.get("hbmBytes"),
             "modelBytes": totals.get("modelBytes"),
+            "flops": totals.get("flops"),
         },
         "outputs": dict(outputs or {}),
         "stages": [
@@ -143,10 +147,129 @@ def runtime_conformance_model(
                 "kind": s.get("kind"),
                 "hbmBytes": s.get("hbmBytes"),
                 "d2hBytes": s.get("d2hBytes"),
+                "flops": s.get("flops"),
             }
             for s in (stages or [])
         ],
     }
+
+
+# ---------------------------------------------------------------------------
+# Latency closed forms (the time axis): roofline milliseconds from the
+# byte/FLOP closed forms above plus a measured machine profile
+# (obs/calibrate.py). The per-stage form is the classic roofline —
+# a stage is either bandwidth-bound or compute-bound, never both:
+#
+#     stage_ms = max(bytes / HBM_BW, flops / F) [+ dispatch overhead]
+#
+# These are LOWER bounds by construction (peak-bandwidth streaming,
+# peak dense FLOP/s); achieved efficiency on gather/sort-heavy SQL
+# stages runs below peak, so the DX520 runtime band that judges
+# observed-vs-predicted is wide (it catches wholesale regressions —
+# a bandwidth collapse, dispatch-overhead domination, an HBM re-layout
+# — not micro-inefficiency).
+# ---------------------------------------------------------------------------
+def stage_time_ms(
+    hbm_bytes: float, flops: float, profile: Dict[str, float],
+) -> float:
+    """Roofline milliseconds of one stage under ``profile`` (a
+    ``MachineProfile.to_dict()``): max of the memory term (stage bytes
+    at the slower of the read/write streams) and the compute term.
+    Dispatch overhead is NOT included — the whole jitted step pays it
+    once, not per stage."""
+    bw = min(
+        float(profile.get("hbm_read_gbps") or 1.0),
+        float(profile.get("hbm_write_gbps") or 1.0),
+    )
+    flop_rate = float(profile.get("flops_gflops") or 1.0)
+    mem_ms = float(hbm_bytes) / max(bw, 1e-9) / 1e6
+    compute_ms = float(flops) / max(flop_rate, 1e-9) / 1e6
+    return max(mem_ms, compute_ms)
+
+
+def transfer_time_ms(bytes_: float, gbps: Optional[float]) -> Optional[float]:
+    """Milliseconds to move ``bytes_`` over a link of ``gbps`` (D2H or
+    ICI); None when the link bandwidth is unknown (e.g. no mesh)."""
+    if not gbps:
+        return None
+    return float(bytes_) / float(gbps) / 1e6
+
+
+def latency_model(
+    stages: list,
+    totals: Dict[str, object],
+    profile: Dict[str, float],
+    profile_source: str = "default",
+) -> dict:
+    """The ``latencyModel`` report block: per-stage roofline ms plus
+    the batch-level decomposition the runtime stages map onto —
+    ``deviceStepMs`` (every stage's compute, one dispatch overhead),
+    ``d2hMs`` (the full-fetch output transfer), ``iciMs`` (the DX7xx
+    wire bytes over the calibrated link). ``stages``/``totals`` are
+    dict-shaped (``StageCost.to_dict()`` / ``DevicePlanReport.totals()``
+    or the conf-embedded runtime model). Consumed by the ``--device``
+    report, the designer Validate cost table, bench.py's roofline
+    block, and the host's DX520/DX521 predictions."""
+    overhead_ms = float(profile.get("dispatch_overhead_us") or 0.0) / 1000.0
+    out_stages = []
+    compute_ms = 0.0
+    for s in stages or []:
+        ms = stage_time_ms(
+            float(s.get("hbmBytes") or 0.0), float(s.get("flops") or 0.0),
+            profile,
+        )
+        compute_ms += ms
+        out_stages.append({
+            "name": s.get("name"),
+            "kind": s.get("kind"),
+            "computeMs": round(ms, 4),
+        })
+    d2h_bytes = float(totals.get("d2hBytesPerBatch") or 0.0)
+    d2h_ms = transfer_time_ms(d2h_bytes, profile.get("d2h_gbps"))
+    ici_bytes = float(
+        totals.get("iciWireBytesPerBatch")
+        or totals.get("iciBytesPerBatch") or 0.0
+    )
+    ici_ms = transfer_time_ms(ici_bytes, profile.get("ici_gbps"))
+    device_step_ms = compute_ms + overhead_ms
+    return {
+        "profileSource": profile_source,
+        "profile": {
+            k: profile.get(k)
+            for k in (
+                "backend", "device_kind", "hbm_read_gbps",
+                "hbm_write_gbps", "flops_gflops", "dispatch_overhead_us",
+                "d2h_gbps", "ici_gbps",
+            )
+        },
+        "stages": out_stages,
+        "totals": {
+            "computeMs": round(compute_ms, 4),
+            "dispatchOverheadMs": round(overhead_ms, 4),
+            "deviceStepMs": round(device_step_ms, 4),
+            "d2hMs": round(d2h_ms, 4) if d2h_ms is not None else None,
+            "iciMs": round(ici_ms, 4) if ici_ms is not None else None,
+            "batchMs": round(
+                device_step_ms + (d2h_ms or 0.0) + (ici_ms or 0.0), 4
+            ),
+        },
+    }
+
+
+def stage_latency_predictions(model: dict) -> Dict[str, float]:
+    """Map a ``latency_model()`` block onto the runtime histogram
+    stages the host measures (constants.MetricName.STAGES): the DX520
+    comparison keys. Only stages the model can actually predict appear
+    — ``device-step`` (compute + one dispatch overhead) and ``collect``
+    (the D2H landing of the output tables). Decode/sinks/checkpoint are
+    host-side I/O the device model deliberately does not cover."""
+    totals = model.get("totals") or {}
+    out: Dict[str, float] = {}
+    if totals.get("deviceStepMs"):
+        out["device-step"] = float(totals["deviceStepMs"])
+    if totals.get("d2hMs"):
+        out["collect"] = float(totals["d2hMs"])
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -193,13 +193,37 @@ class DevicePlanReport:
 
     def plan_dict(self) -> dict:
         """The cost-report portion (no diagnostics) — what the designer
-        renders beside the diagnostics list."""
+        renders beside the diagnostics list. Includes the roofline
+        ``latencyModel`` (closed-form milliseconds under a machine
+        profile — the datasheet default here; a *calibrated* profile
+        replaces it wherever one is available: the host's DX520
+        predictions and bench.py's roofline block)."""
         return {
             "flow": self.flow,
             "chips": self.chips,
             "stages": [s.to_dict() for s in self.stages],
             "totals": self.totals(),
+            "latencyModel": self.latency_model(),
         }
+
+    def latency_model(
+        self, profile: Optional[dict] = None, source: str = "default",
+    ) -> dict:
+        """The time axis of this report: per-stage roofline ms + the
+        deviceStep/d2h/ici decomposition (costmodel.latency_model)
+        under ``profile`` (a ``MachineProfile.to_dict()``; the static
+        datasheet default when None)."""
+        from .costmodel import latency_model
+
+        if profile is None:
+            from ..obs.calibrate import DEFAULT_PROFILE
+
+            profile = DEFAULT_PROFILE.to_dict()
+            source = "default"
+        return latency_model(
+            [s.to_dict() for s in self.stages], self.totals(),
+            profile, profile_source=source,
+        )
 
     def to_dict(self) -> dict:
         from .diagnostics import REPORT_SCHEMA_VERSION

@@ -198,6 +198,45 @@ class MeshPlanReport:
             "validated": self.validated,
             "stages": [s.to_dict() for s in self.stages],
             "totals": self.totals(),
+            "latencyModel": self.latency_model(),
+        }
+
+    def latency_model(
+        self, profile: Optional[dict] = None, source: str = "default",
+    ) -> dict:
+        """The wire-time axis of the sharding plan: the DX7xx collective
+        wire bytes priced over the profile's ICI link bandwidth
+        (per-stage and total ms). Like the device tier's latencyModel
+        this is a roofline lower bound — the datasheet default profile
+        unless a calibrated one is passed."""
+        from .costmodel import transfer_time_ms
+
+        if profile is None:
+            from ..obs.calibrate import DEFAULT_PROFILE
+
+            profile = DEFAULT_PROFILE.to_dict()
+            source = "default"
+        gbps = profile.get("ici_gbps")
+        stages = [
+            {
+                "name": s.name,
+                "iciMs": (
+                    round(transfer_time_ms(s.ici_wire_bytes, gbps), 4)
+                    if gbps else None
+                ),
+            }
+            for s in self.stages
+        ]
+        total = transfer_time_ms(
+            self.totals()["iciWireBytesPerBatch"], gbps
+        )
+        return {
+            "profileSource": source,
+            "iciGBps": gbps,
+            "stages": stages,
+            "totals": {
+                "iciMs": round(total, 4) if total is not None else None,
+            },
         }
 
     def to_dict(self) -> dict:

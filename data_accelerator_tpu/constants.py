@@ -142,7 +142,31 @@ class MetricName:
         # mesh ICI drift ratio (observed Mesh_ICI_Bytes / the embedded
         # sharding model's wire prediction — the DX510 gauge)
         r"Conformance_MeshIci_Ratio",
+        # roofline time-model conformance (obs/conformance.py DX520/
+        # DX521): observed per-stage latency p50 / the calibrated
+        # roofline prediction, one gauge per predicted stage
+        r"Conformance_StageTime_[A-Za-z]+_Ratio",
+        # live HBM peak / the DX2xx modeled footprint (the DX522 gauge)
+        r"Conformance_Hbm_Ratio",
         r"Conformance_Drift_Count",
+        # calibrated machine profile (obs/calibrate.py): the measured
+        # constants the roofline predictions are priced with — HBM
+        # read/write GB/s, dense GFLOP/s, per-dispatch overhead µs,
+        # D2H GB/s and (under a mesh) ICI GB/s
+        r"Calib_HbmReadGBps",
+        r"Calib_HbmWriteGBps",
+        r"Calib_FlopsGFlops",
+        r"Calib_DispatchOverheadUs",
+        r"Calib_D2HGBps",
+        r"Calib_IciGBps",
+        # live HBM watermark sampler (runtime/processor.py
+        # device_memory_stats, exported per batch when the backend
+        # reports allocator stats)
+        r"Hbm_BytesInUse",
+        r"Hbm_PeakBytes",
+        # on-demand profiler surface (obs/profiler.py): cumulative
+        # finished captures this host has written
+        r"Profiler_Captures_Count",
         # AOT compile + persistent compilation cache
         # (runtime/processor.py process.compile.*): init-time warm cost,
         # persistent-cache hit/miss counts at cache-entry granularity,

@@ -17,6 +17,17 @@ fetches a host's (or the website's) ``GET /alerts`` and renders the
 rule table with firing state; ``alerts --validate rules.json``
 schema-checks a rule file (obs/alerts.py RULE_SCHEMA) and exits
 non-zero on errors.
+
+``python -m data_accelerator_tpu.obs profile <url> [--seconds N]``
+POSTs ``/profile?seconds=N`` on a live host's observability port —
+the on-demand jax profiler surface (obs/profiler.py) — and prints the
+capture path the host returned.
+
+``python -m data_accelerator_tpu.obs spans [--aggregate] [--file F]``
+reads the flight recorder's span records; with ``--aggregate`` it
+renders the flame table — stage -> count / total ms / p50 / p99 —
+the offline rollup of the same per-stage decomposition the live
+histograms serve.
 """
 
 from __future__ import annotations
@@ -200,6 +211,108 @@ def cmd_alerts(args) -> int:
     return 1 if firing else 0
 
 
+def _pctl(sorted_vals: List[float], q: float) -> float:
+    """numpy-'linear' percentile over pre-sorted values (matches
+    obs/histogram.py LatencyHistogram.percentile)."""
+    n = len(sorted_vals)
+    if n == 1:
+        return sorted_vals[0]
+    pos = (q / 100.0) * (n - 1)
+    lo = int(pos)
+    hi = min(lo + 1, n - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac
+
+
+def cmd_spans(args) -> int:
+    spans = load_spans(args.file)
+    if not spans:
+        print(f"no spans found in {args.file}", file=sys.stderr)
+        return 2
+    if not args.aggregate:
+        for s in spans[-args.limit:]:
+            print(
+                f"{s.get('trace')} {s.get('name'):<20} "
+                f"{s.get('durationMs', 0):>10.2f} ms"
+            )
+        return 0
+    # flame table: stage -> count/total/p50/p99 (+ the exemplar-style
+    # max trace id, so the worst observation is one `obs trace` away)
+    groups: Dict[str, List[dict]] = {}
+    for s in spans:
+        groups.setdefault(s.get("name") or "?", []).append(s)
+    if args.json:
+        out = []
+        for name, ss in groups.items():
+            durs = sorted(float(s.get("durationMs") or 0.0) for s in ss)
+            worst = max(ss, key=lambda s: float(s.get("durationMs") or 0.0))
+            out.append({
+                "stage": name,
+                "count": len(durs),
+                "totalMs": round(sum(durs), 2),
+                "p50Ms": round(_pctl(durs, 50), 3),
+                "p99Ms": round(_pctl(durs, 99), 3),
+                "maxMs": round(durs[-1], 3),
+                "maxTrace": worst.get("trace"),
+            })
+        out.sort(key=lambda r: -r["totalMs"])
+        print(json.dumps(out, indent=1))
+        return 0
+    rows = []
+    for name, ss in groups.items():
+        durs = sorted(float(s.get("durationMs") or 0.0) for s in ss)
+        worst = max(ss, key=lambda s: float(s.get("durationMs") or 0.0))
+        rows.append((
+            name, len(durs), sum(durs), _pctl(durs, 50), _pctl(durs, 99),
+            durs[-1], worst.get("trace"),
+        ))
+    rows.sort(key=lambda r: -r[2])
+    print(f"{'stage':<24} {'count':>7} {'total ms':>12} "
+          f"{'p50 ms':>10} {'p99 ms':>10} {'max ms':>10}  max trace")
+    for name, n, total, p50, p99, mx, trace in rows:
+        print(f"{name:<24} {n:>7} {total:>12.1f} "
+              f"{p50:>10.2f} {p99:>10.2f} {mx:>10.2f}  {trace}")
+    return 0
+
+
+def cmd_profile(args) -> int:
+    import urllib.parse
+    import urllib.request
+
+    url = (
+        args.url.rstrip("/")
+        + "/profile?"
+        + urllib.parse.urlencode({"seconds": args.seconds})
+    )
+    try:
+        req = urllib.request.Request(url, data=b"", method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            payload = json.loads(r.read() or b"{}")
+            status = r.status
+    except OSError as e:
+        body = getattr(e, "read", lambda: b"")()
+        try:
+            payload = json.loads(body or b"{}")
+        except ValueError:
+            payload = {}
+        if not payload:
+            print(f"cannot reach {url}: {e}", file=sys.stderr)
+            return 2
+        status = getattr(e, "code", 500)
+    if args.json:
+        print(json.dumps(payload, indent=1))
+        return 0 if status == 200 else 1
+    if "error" in payload:
+        print(f"profiler error: {payload['error']}", file=sys.stderr)
+        return 1
+    print(
+        f"capture armed for {payload.get('seconds')}s -> "
+        f"{payload.get('path')}"
+    )
+    print("open with: tensorboard --logdir <path>  (or xprof)")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m data_accelerator_tpu.obs",
@@ -232,11 +345,47 @@ def main(argv=None) -> int:
         help="schema-check a rule file instead of querying a host",
     )
     ap.add_argument("--json", action="store_true", help="raw JSON payload")
+    sp = sub.add_parser(
+        "spans", help="span records from the flight recorder; "
+                      "--aggregate renders the per-stage flame table"
+    )
+    sp.add_argument(
+        "--file",
+        default=os.environ.get("DATAX_TRACE_FILE", "telemetry.jsonl"),
+        help="JSONL flight-recorder path (default: $DATAX_TRACE_FILE "
+             "or ./telemetry.jsonl)",
+    )
+    sp.add_argument(
+        "--aggregate", action="store_true",
+        help="roll spans up per stage (count/total/p50/p99/max trace)",
+    )
+    sp.add_argument(
+        "--limit", type=int, default=50,
+        help="without --aggregate: how many recent spans to list",
+    )
+    sp.add_argument("--json", action="store_true", help="JSON rollup")
+    pp = sub.add_parser(
+        "profile", help="arm an on-demand jax profiler capture on a "
+                        "live host (POST <url>/profile)"
+    )
+    pp.add_argument(
+        "url", help="base URL of a host observability endpoint "
+                    "(process.observability.port)",
+    )
+    pp.add_argument(
+        "--seconds", type=float, default=5.0,
+        help="capture window in seconds (default 5)",
+    )
+    pp.add_argument("--json", action="store_true", help="raw JSON payload")
     args = parser.parse_args(argv)
     if args.cmd == "trace":
         return cmd_trace(args)
     if args.cmd == "alerts":
         return cmd_alerts(args)
+    if args.cmd == "spans":
+        return cmd_spans(args)
+    if args.cmd == "profile":
+        return cmd_profile(args)
     return 2
 
 

@@ -23,6 +23,16 @@ counts — against those predictions and exports:
   | DX503 | unmodeled-retrace | the jitted step re-traced after warmup; steady state is modeled as trace-free |
   | DX510 | ici-bytes-drift | windowed observed mesh collective bytes (``Mesh_ICI_Bytes``) exceed the DX7xx sharding model's wire prediction by more than the tolerance band |
   | DX511 | mesh-collective-count-drift | the executed mesh program's collective-op census (``Mesh_Reshard_Count``) changed from its post-warmup baseline — a re-trace repartitioned the step |
+  | DX520 | stage-time-drift | a stage's observed latency p50 exceeds the calibrated roofline prediction (``max(bytes/BW, flops/F) + dispatch overhead`` over the measured machine profile, obs/calibrate.py) by more than the band |
+  | DX521 | dispatch-overhead-dominated | DX520's condition on a stage whose *model* is all fixed dispatch overhead — the slowdown is per-dispatch cost, not data movement |
+  | DX522 | hbm-footprint-drift | live HBM peak (``Hbm_PeakBytes``, the per-window ``memory_stats`` sample) drifted above the DX2xx modeled footprint band |
+
+The DX52x trio is the *time* half of the loop (PR 12): S620 embeds the
+byte+FLOP closed forms; the host calibrates its own machine profile at
+init (``obs/calibrate.py``) and prices them into per-stage roofline
+milliseconds (``ConformanceModel.latency_predictions``), which the
+monitor judges against the same windowed ``Latency-<Stage>-p50``
+histogram series the dashboards read.
 
 The DX51x pair is the runtime half of the mesh tier
 (``analysis/meshcheck.py``): config generation embeds the sharding
@@ -60,6 +70,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ..constants import MetricName
+
 logger = logging.getLogger(__name__)
 
 # runtime drift code registry (documented in OBSERVABILITY.md
@@ -70,6 +82,9 @@ DRIFT_CODES: Dict[str, str] = {
     "DX503": "unmodeled-retrace",
     "DX510": "ici-bytes-drift",
     "DX511": "mesh-collective-count-drift",
+    "DX520": "stage-time-drift",
+    "DX521": "dispatch-overhead-dominated",
+    "DX522": "hbm-footprint-drift",
 }
 
 # observed/predicted ratio above which DX501 fires (sized transfer makes
@@ -88,6 +103,27 @@ DEFAULT_D2H_RATIO_HIGH = 1.5
 DEFAULT_ICI_RATIO_HIGH = 8.0
 # observed rows / modeled cardinality above which DX502 fires
 DEFAULT_OCCUPANCY_FACTOR = 2.0
+# observed p50 / predicted roofline ms above which DX520 fires. The
+# latency closed forms are LOWER bounds (peak bandwidth, peak dense
+# FLOP/s — analysis/costmodel.py stage_time_ms); achieved efficiency
+# on gather/sort-heavy SQL stages legitimately runs several-fold under
+# peak, so like DX510 the band is wide: it catches a stage going
+# wholesale slow (bandwidth regression, dispatch-overhead domination,
+# an HBM re-layout), not roofline optimism.
+DEFAULT_STAGE_TIME_RATIO_HIGH = 10.0
+# predicted ms below which DX520/DX521 decline to judge a stage: a
+# sub-millisecond roofline prediction means fixed host-side costs the
+# device model deliberately does not cover (row materialization, GIL
+# scheduling, tunnel RTT) dominate the observation, and any ratio
+# against it is noise, not drift — the missing-prediction posture
+# (silence) applies. An explicit conformance.latency PIN is always
+# judged: the operator asserted the number.
+DEFAULT_STAGE_TIME_FLOOR_MS = 1.0
+# observed live HBM peak / the DX2xx modeled footprint above which
+# DX522 fires — the byte model is exact (tier-1 asserts model ==
+# lowering), so the band only needs to absorb allocator slack and
+# jax runtime scratch, not model error
+DEFAULT_HBM_RATIO_HIGH = 1.5
 # windowed samples required before ratios are judged (and before a
 # retrace counts as unmodeled — the first trace IS the model)
 DEFAULT_WARMUP_BATCHES = 4
@@ -128,9 +164,13 @@ class ConformanceModel:
 
     d2h_bytes_per_batch: Optional[float] = None
     hbm_bytes: Optional[float] = None
+    # modeled FLOPs/batch across all stages (the compute side of the
+    # DX520 roofline prediction)
+    flops: Optional[float] = None
     # output dataset -> {"rows": modeled cardinality, "capacity": padded}
     outputs: Dict[str, dict] = field(default_factory=dict)
-    # per-stage d2hBytes (informational; the CLI/SPA render it)
+    # per-stage hbmBytes/d2hBytes/flops (the DX520 latency inputs; the
+    # CLI/SPA also render it)
     stages: List[dict] = field(default_factory=list)
     # mesh sharding-plan predictions (datax.job.process.mesh.model, the
     # DX7xx analyzer's runtime artifact): modeled collective wire bytes
@@ -165,6 +205,7 @@ class ConformanceModel:
         return cls(
             d2h_bytes_per_batch=totals.get("d2hBytesPerBatch"),
             hbm_bytes=totals.get("hbmBytes"),
+            flops=totals.get("flops"),
             outputs={
                 k: v for k, v in (obj.get("outputs") or {}).items()
                 if isinstance(v, dict)
@@ -186,6 +227,37 @@ class ConformanceModel:
             return None
         return cls.from_json(raw or "", mesh_raw)
 
+    def latency_predictions(self, profile: dict) -> tuple:
+        """The DX520 comparison baseline: roofline per-stage latency
+        under ``profile`` (a calibrated ``MachineProfile.to_dict()``).
+        Bytes and FLOPs travel in the conf-embedded model; this turns
+        them into milliseconds on the machine that will be judged.
+        Returns ``(predictions, compute_ms, overhead_ms)`` —
+        predictions keyed by runtime histogram stage, and the model's
+        compute-vs-dispatch-overhead split (the DX521 input: a stage
+        whose predicted time is all fixed overhead has nothing to gain
+        from bandwidth, only from batching/fusing dispatches)."""
+        from ..analysis.costmodel import (
+            latency_model,
+            stage_latency_predictions,
+        )
+
+        model = latency_model(
+            self.stages,
+            {
+                "d2hBytesPerBatch": self.d2h_bytes_per_batch,
+                "flops": self.flops,
+            },
+            profile,
+            profile_source="calibrated",
+        )
+        totals = model["totals"]
+        return (
+            stage_latency_predictions(model),
+            float(totals["computeMs"]),
+            float(totals["dispatchOverheadMs"]),
+        )
+
 
 class ConformanceMonitor:
     """Windowed model-vs-observed comparison, fed once per batch finish
@@ -202,6 +274,9 @@ class ConformanceMonitor:
         d2h_ratio_high: float = DEFAULT_D2H_RATIO_HIGH,
         occupancy_factor: float = DEFAULT_OCCUPANCY_FACTOR,
         ici_ratio_high: float = DEFAULT_ICI_RATIO_HIGH,
+        stage_time_ratio_high: float = DEFAULT_STAGE_TIME_RATIO_HIGH,
+        stage_time_floor_ms: float = DEFAULT_STAGE_TIME_FLOOR_MS,
+        hbm_ratio_high: float = DEFAULT_HBM_RATIO_HIGH,
     ):
         self.model = model
         self.flow = flow
@@ -210,10 +285,23 @@ class ConformanceMonitor:
         self.d2h_ratio_high = float(d2h_ratio_high)
         self.occupancy_factor = float(occupancy_factor)
         self.ici_ratio_high = float(ici_ratio_high)
+        self.stage_time_ratio_high = float(stage_time_ratio_high)
+        self.stage_time_floor_ms = float(stage_time_floor_ms)
+        self.hbm_ratio_high = float(hbm_ratio_high)
+        # DX520/DX521 state: runtime-stage -> predicted roofline ms,
+        # set by set_latency() once the host has a calibrated profile
+        # (or pinned from the conf's conformance.latency override);
+        # the compute/overhead split routes drift to DX521 when the
+        # model says the stage is all fixed dispatch cost
+        self.latency: Dict[str, float] = {}
+        self.latency_pinned = False
+        self._latency_compute_ms = 0.0
+        self._latency_overhead_ms = 0.0
         self.batches = 0
         self.drift_count = 0
         self._d2h: deque = deque(maxlen=self.window)
         self._ici: deque = deque(maxlen=self.window)
+        self._hbm: deque = deque(maxlen=self.window)
         # the executed mesh program's first post-warmup collective-op
         # count — DX511's self-baseline (a change means a re-trace
         # repartitioned the step)
@@ -226,15 +314,39 @@ class ConformanceMonitor:
     @classmethod
     def from_conf(cls, dict_, flow: str = "") -> Optional["ConformanceMonitor"]:
         model = ConformanceModel.from_conf(dict_)
-        if model is None:
-            return None
         sub = dict_.get_sub_dictionary("datax.job.process.conformance.")
+        # operator latency pin: conformance.latency = JSON stage->ms
+        # replaces the computed roofline predictions outright (the
+        # injected-slowdown acceptance drill uses the same door)
+        pin: Optional[Dict[str, float]] = None
+        lat_raw = sub.get("latency")
+        if lat_raw:
+            try:
+                parsed = json.loads(lat_raw)
+                if isinstance(parsed, dict):
+                    pin = {
+                        str(k): float(v) for k, v in parsed.items()
+                        if isinstance(v, (int, float))
+                    }
+            except ValueError:
+                logger.warning(
+                    "unparseable conformance.latency pin; ignored"
+                )
+        if model is None:
+            # a valid pin alone arms the monitor (DX520/521 only) —
+            # the operator asserted the numbers, no byte model needed
+            if not pin:
+                return None
+            model = ConformanceModel()
         window = sub.get_int_option("window")
         warmup = sub.get_int_option("warmup")
         high = sub.get_double_option("d2hratiohigh")
         occ = sub.get_double_option("occupancyfactor")
         ici = sub.get_double_option("iciratiohigh")
-        return cls(
+        stage_t = sub.get_double_option("stagetimeratiohigh")
+        stage_floor = sub.get_double_option("stagetimefloorms")
+        hbm = sub.get_double_option("hbmratiohigh")
+        mon = cls(
             model,
             flow=flow,
             window=window if window is not None else DEFAULT_WINDOW,
@@ -248,7 +360,41 @@ class ConformanceMonitor:
             ici_ratio_high=(
                 ici if ici is not None else DEFAULT_ICI_RATIO_HIGH
             ),
+            stage_time_ratio_high=(
+                stage_t if stage_t is not None
+                else DEFAULT_STAGE_TIME_RATIO_HIGH
+            ),
+            stage_time_floor_ms=(
+                stage_floor if stage_floor is not None
+                else DEFAULT_STAGE_TIME_FLOOR_MS
+            ),
+            hbm_ratio_high=(
+                hbm if hbm is not None else DEFAULT_HBM_RATIO_HIGH
+            ),
         )
+        if pin:
+            mon.set_latency(pin, pinned=True)
+        return mon
+
+    def set_latency(
+        self,
+        predictions: Dict[str, float],
+        compute_ms: float = 0.0,
+        overhead_ms: float = 0.0,
+        pinned: bool = False,
+    ) -> None:
+        """Arm the DX520/DX521 checks with per-stage predicted ms
+        (``ConformanceModel.latency_predictions`` output, or an
+        explicit conf pin — a pin wins over computed predictions and
+        is never overwritten by the host's calibration)."""
+        if self.latency_pinned and not pinned:
+            return
+        self.latency = {
+            k: float(v) for k, v in (predictions or {}).items() if v
+        }
+        self._latency_compute_ms = float(compute_ms)
+        self._latency_overhead_ms = float(overhead_ms)
+        self.latency_pinned = self.latency_pinned or pinned
 
     # -- transitions -----------------------------------------------------
     def _transition(
@@ -372,6 +518,91 @@ class ConformanceMonitor:
                     f"step re-traced into a different partition "
                     f"(dictionary growth or UDF refresh under the "
                     f"mesh; see DX204/DX600)",
+                ),
+            )
+            if ev:
+                events.append(ev)
+
+        # DX520/DX521: observed per-stage latency p50 vs the roofline
+        # prediction (the calibrated time model). The host merges the
+        # windowed histogram percentiles into the metric dict BEFORE
+        # this observe, so the comparison input is the same
+        # Latency-<Stage>-p50 series every dashboard reads. DX521
+        # replaces DX520 for a stage whose predicted time is all fixed
+        # dispatch overhead (bytes*BW + flops/F tiny): going slow there
+        # is dispatch-overhead domination, and more bandwidth won't fix
+        # it — fewer/fused dispatches will.
+        for stage, predicted_ms in self.latency.items():
+            camel = MetricName.stage_metric(stage)[len("Latency-"):]
+            observed_ms = metrics.get(f"Latency-{camel}-p50")
+            if observed_ms is None or not predicted_ms:
+                continue
+            ratio = float(observed_ms) / float(predicted_ms)
+            gauges[f"Conformance_StageTime_{camel}_Ratio"] = ratio
+            # DX521 routing needs a known compute/overhead split (a
+            # pinned prediction has none — drift there is plain DX520)
+            overhead_bound = (
+                stage == "device-step"
+                and self._latency_overhead_ms > 0
+                and self._latency_compute_ms <= self._latency_overhead_ms
+            )
+            code = "DX521" if overhead_bound else "DX520"
+            # sub-floor predictions decline to judge (host-side fixed
+            # costs dominate the observation); an explicit latency pin
+            # is always judged
+            judged = (
+                self.latency_pinned
+                or float(predicted_ms) >= self.stage_time_floor_ms
+            )
+            ev = self._transition(
+                f"DX52x:{stage}",
+                warmed and judged and ratio > self.stage_time_ratio_high,
+                lambda s=stage, c=code, cm=camel, o=float(observed_ms),
+                p=float(predicted_ms), r=ratio: DriftEvent(
+                    c, f"Latency-{cm}-p50",
+                    o, p, r, batch_time_ms,
+                    (
+                        f"stage '{s}' p50 {o:.2f}ms vs roofline "
+                        f"{p:.2f}ms ({r:.1f}x > "
+                        f"{self.stage_time_ratio_high}x)"
+                        + (
+                            " — the model is dispatch-overhead bound "
+                            "(bytes/BW and flops/F are negligible): "
+                            "the time is going into per-dispatch fixed "
+                            "cost, not data movement; batch more work "
+                            "per dispatch"
+                            if c == "DX521" else
+                            " — bandwidth regression, HBM re-layout or "
+                            "an unmodeled slow path; re-profile with "
+                            "POST /profile and re-validate with "
+                            "--device"
+                        )
+                    ),
+                ),
+            )
+            if ev:
+                events.append(ev)
+
+        # DX522: live HBM peak vs the DX2xx modeled footprint. The
+        # observation is the per-window Hbm_PeakBytes sample
+        # (jax memory_stats — absent on backends that don't report,
+        # where the posture is silence like every missing input).
+        hbm_peak = metrics.get("Hbm_PeakBytes")
+        predicted_hbm = self.model.hbm_bytes
+        if hbm_peak is not None and predicted_hbm:
+            self._hbm.append(float(hbm_peak))
+            mean = sum(self._hbm) / len(self._hbm)
+            ratio = mean / float(predicted_hbm)
+            gauges["Conformance_Hbm_Ratio"] = ratio
+            ev = self._transition(
+                "DX522", warmed and ratio > self.hbm_ratio_high,
+                lambda m=mean, p=float(predicted_hbm), r=ratio: DriftEvent(
+                    "DX522", "Hbm_PeakBytes", m, p, r, batch_time_ms,
+                    f"live HBM peak {m:.0f}B drifted above the modeled "
+                    f"footprint {p:.0f}B by {r:.2f}x "
+                    f"(> {self.hbm_ratio_high}x) — fragmentation forcing "
+                    f"re-layout, an unmodeled allocation, or stale "
+                    f"capacity planning (re-run --device / --fleet)",
                 ),
             )
             if ev:

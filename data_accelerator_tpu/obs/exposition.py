@@ -262,9 +262,18 @@ def render_prometheus(
                     f'datax_stage_latency_ms_bucket{{{labels},'
                     f'le="{_fmt(bound)}"}} {cum}'
                 )
+            # OpenMetrics-style exemplar on the +Inf bucket: the trace
+            # id of the window's max-duration observation, so a p99
+            # spike on a dashboard links to `obs trace <id>` directly
+            ex = hist.exemplar()
+            ex_s = (
+                f' # {{trace_id="{_esc(ex["traceId"])}"}} '
+                f'{_fmt(ex["ms"])}'
+                if ex and ex.get("traceId") else ""
+            )
             out.append(
                 f'datax_stage_latency_ms_bucket{{{labels},le="+Inf"}} '
-                f'{snap["count"]}'
+                f'{snap["count"]}{ex_s}'
             )
             out.append(
                 f'datax_stage_latency_ms_sum{{{labels}}} '
@@ -368,11 +377,13 @@ class ObservabilityServer:
         host: str = "127.0.0.1",
         port: int = 0,
         alerts=None,
+        profiler=None,
     ):
         self.health = health
         self.histograms = histograms if histograms is not None else HISTOGRAMS
         self.store = store if store is not None else METRIC_STORE
         self.alerts = alerts  # obs.alerts.AlertEngine | None
+        self.profiler = profiler  # obs.profiler.ProfilerSurface | None
         obs = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -427,10 +438,64 @@ class ObservabilityServer:
                         status, json.dumps(payload).encode(),
                         "application/json",
                     )
+                elif path == "/profile":
+                    # capture state for pollers (POST starts one)
+                    if obs.profiler is None:
+                        self._send(
+                            501,
+                            b'{"error": "profiler surface disabled"}',
+                            "application/json",
+                        )
+                        return
+                    payload = {
+                        "available": obs.profiler.available,
+                        "active": obs.profiler.active(),
+                        "captures": obs.profiler.captures_count,
+                    }
+                    self._send(
+                        200, json.dumps(payload).encode(),
+                        "application/json",
+                    )
                 else:
                     self._send(
                         404, b'{"error": "not found"}', "application/json"
                     )
+
+            def do_POST(self):
+                path, _, query = self.path.partition("?")
+                if path != "/profile":
+                    self._send(
+                        404, b'{"error": "not found"}', "application/json"
+                    )
+                    return
+                if obs.profiler is None or not obs.profiler.available:
+                    self._send(
+                        501,
+                        json.dumps({
+                            "error": "jax profiler unavailable "
+                                     "(surface disabled or backend "
+                                     "without profiler support)",
+                        }).encode(),
+                        "application/json",
+                    )
+                    return
+                seconds = None
+                for part in query.split("&"):
+                    k, _, v = part.partition("=")
+                    if k == "seconds":
+                        try:
+                            seconds = float(v)
+                        except ValueError:
+                            pass
+                from .profiler import DEFAULT_SECONDS
+
+                result = obs.profiler.start(
+                    seconds if seconds is not None else DEFAULT_SECONDS
+                )
+                status = 200 if "error" not in result else 409
+                self._send(
+                    status, json.dumps(result).encode(), "application/json"
+                )
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._server.daemon_threads = True

@@ -53,11 +53,15 @@ class LatencyHistogram:
         self.count = 0
         self.sum_ms = 0.0
         self._window: List[float] = []
+        # per-sample trace ids, parallel to _window: the exemplar side
+        # channel (a p99 spike on a dashboard links straight to the
+        # offending batch's trace — `obs trace <id>`)
+        self._window_ids: List[Optional[str]] = []
         self._window_cap = window
         self._window_pos = 0
         self._lock = threading.Lock()
 
-    def observe(self, ms: float) -> None:
+    def observe(self, ms: float, trace_id: Optional[str] = None) -> None:
         ms = float(ms)
         with self._lock:
             i = 0
@@ -71,9 +75,22 @@ class LatencyHistogram:
             self.sum_ms += ms
             if len(self._window) < self._window_cap:
                 self._window.append(ms)
+                self._window_ids.append(trace_id)
             else:
                 self._window[self._window_pos] = ms
+                self._window_ids[self._window_pos] = trace_id
                 self._window_pos = (self._window_pos + 1) % self._window_cap
+
+    def exemplar(self) -> Optional[Dict[str, object]]:
+        """The max-duration observation currently in the window and its
+        trace id: ``{"ms": float, "traceId": str|None}``. None when the
+        window is empty. This is what ``/metrics`` attaches as the
+        OpenMetrics-style exemplar on the +Inf bucket."""
+        with self._lock:
+            if not self._window:
+                return None
+            i = max(range(len(self._window)), key=self._window.__getitem__)
+            return {"ms": self._window[i], "traceId": self._window_ids[i]}
 
     def percentile(self, q: float) -> Optional[float]:
         """Exact percentile over the recent-sample window (numpy's
@@ -132,8 +149,11 @@ class HistogramRegistry:
                 h = self._hists[key] = LatencyHistogram(self.buckets_ms)
             return h
 
-    def observe(self, flow: str, stage: str, ms: float) -> None:
-        self.get(flow, stage).observe(ms)
+    def observe(
+        self, flow: str, stage: str, ms: float,
+        trace_id: Optional[str] = None,
+    ) -> None:
+        self.get(flow, stage).observe(ms, trace_id=trace_id)
 
     def percentile(self, flow: str, stage: str, q: float) -> Optional[float]:
         key = (flow, stage)

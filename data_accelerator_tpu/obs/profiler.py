@@ -1,0 +1,147 @@
+"""On-demand jax profiler surface: ``POST /profile?seconds=N``.
+
+Replaces the old first-N-batches trace dump (a conf key you had to set
+BEFORE starting the host, which is never when the mystery happens):
+a live host now arms ``jax.profiler`` on demand through its
+observability port, captures for N seconds while batches keep flowing,
+and the capture lands beside the flight recorder —
+
+- ``POST <host>/profile?seconds=N`` (obs/exposition.py) starts a
+  capture and returns its path immediately; a timer thread stops the
+  trace when the window closes.
+- every finished capture is drained by the streaming host at the next
+  batch finish and recorded as a ``profiler/capture`` span inside that
+  batch's trace (so ``obs trace <batch>`` shows exactly which capture
+  overlapped which batches) and counted by the
+  ``Profiler_Captures_Count`` registry series.
+- ``python -m data_accelerator_tpu.obs profile <url>`` drives it from
+  a terminal; captures open in tensorboard/xprof.
+
+No-op posture: on a backend/build without ``jax.profiler`` the surface
+reports unavailable, the endpoint answers 501, and nothing else
+changes — profiling is diagnostics, never load-bearing.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_SECONDS = 5.0
+MAX_SECONDS = 120.0
+
+
+def profiler_available() -> bool:
+    """True when this process can start a jax profiler trace."""
+    try:
+        import jax.profiler  # noqa: F401
+
+        return hasattr(jax.profiler, "start_trace")
+    except Exception:  # noqa: BLE001 — any import failure = unavailable
+        return False
+
+
+class ProfilerSurface:
+    """One host's on-demand capture state: at most one trace at a time,
+    a timer to close the window, and a drain queue of finished captures
+    for the host to stitch into batch traces."""
+
+    def __init__(self, base_dir: str, flow: str = ""):
+        self.base_dir = base_dir
+        self.flow = flow
+        self.captures_count = 0
+        self._seq = 0
+        self._active: Optional[dict] = None
+        self._timer: Optional[threading.Timer] = None
+        self._finished: List[Dict] = []
+        self._lock = threading.Lock()
+
+    @property
+    def available(self) -> bool:
+        return profiler_available()
+
+    def active(self) -> Optional[dict]:
+        with self._lock:
+            return dict(self._active) if self._active else None
+
+    def start(self, seconds: float = DEFAULT_SECONDS) -> dict:
+        """Arm a capture for ``seconds``; returns
+        ``{path, seconds, active}`` or ``{error}`` (already capturing /
+        profiler unavailable). The path is returned immediately so the
+        caller can watch it fill."""
+        seconds = min(max(float(seconds), 0.1), MAX_SECONDS)
+        if not self.available:
+            return {"error": "jax.profiler unavailable on this backend"}
+        with self._lock:
+            if self._active is not None:
+                return {
+                    "error": "capture already in progress",
+                    "path": self._active["path"],
+                }
+            self._seq += 1
+            path = os.path.join(
+                self.base_dir, f"capture-{self._seq:04d}"
+            )
+            os.makedirs(path, exist_ok=True)
+            import jax
+
+            try:
+                jax.profiler.start_trace(path)
+            except Exception as e:  # noqa: BLE001 — diagnostics only
+                logger.warning("profiler start failed: %s", e)
+                return {"error": f"profiler start failed: {e}"}
+            self._active = {
+                "path": path,
+                "seconds": seconds,
+                "startedTs": time.time(),
+            }
+            self._timer = threading.Timer(seconds, self._stop_timed)
+            self._timer.daemon = True
+            self._timer.start()
+            logger.info(
+                "profiler capture armed for %.1fs -> %s", seconds, path
+            )
+            return {"path": path, "seconds": seconds, "active": True}
+
+    def _stop_timed(self) -> None:
+        try:
+            self.stop()
+        except Exception:  # noqa: BLE001 — timer thread must not die loud
+            logger.exception("timed profiler stop failed")
+
+    def stop(self) -> Optional[str]:
+        """Close the active capture (idempotent); returns its path."""
+        with self._lock:
+            active, self._active = self._active, None
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+        if active is None:
+            return None
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001 — capture may be torn
+            logger.warning("profiler stop failed: %s", e)
+        active["durationMs"] = round(
+            (time.time() - active["startedTs"]) * 1000.0, 1
+        )
+        with self._lock:
+            self.captures_count += 1
+            self._finished.append(active)
+        logger.info("profiler capture written to %s", active["path"])
+        return active["path"]
+
+    def drain_finished(self) -> List[Dict]:
+        """Captures completed since the last drain — the host records
+        each as a ``profiler/capture`` span event on the batch trace
+        that drains it."""
+        with self._lock:
+            out, self._finished = self._finished, []
+            return out

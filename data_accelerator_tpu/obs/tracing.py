@@ -293,7 +293,11 @@ class Tracer:
         # "streaming/" prefix is stripped so its stage is "batch"
         if self.histograms is not None:
             stage = name[10:] if name.startswith("streaming/") else name
-            self.histograms.observe(self.flow, stage, duration_ms)
+            # the span's trace id rides along as the histogram exemplar
+            # (a latency spike links back to the batch that caused it)
+            self.histograms.observe(
+                self.flow, stage, duration_ms, trace_id=ctx.trace_id
+            )
         if not self.enabled or self.telemetry is None:
             return
         self.telemetry.track_span(

@@ -13,8 +13,9 @@ Run one-box:
 from __future__ import annotations
 
 import logging
+import os
 import sys
-import threading
+import tempfile
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
@@ -103,19 +104,6 @@ class StreamingHost:
         # effective rate adapts between maxrate/8 and maxrate)
         self._rate_scale = 1.0
 
-        # profiler hook (SURVEY §5.1: jax profiler traces replace the
-        # reference's AppInsights profiler): conf
-        # process.telemetry.profilerdir=<dir> traces the first
-        # process.telemetry.profilerbatches=<N> batches
-        tele_conf = dict_.get_sub_dictionary("datax.job.process.telemetry.")
-        self._profiler_dir = tele_conf.get("profilerdir")
-        self._profiler_batches = int(
-            tele_conf.get_or_else("profilerbatches", "5")
-        )
-        self._profiling = False
-        # stop() may run on another thread than the loop's finally
-        self._profiler_lock = threading.Lock()
-
         # offset checkpointing (EventhubCheckpointer semantics)
         ckpt_dir = input_conf.get("eventhub.checkpointdir") or input_conf.get(
             "streaming.checkpointdir"
@@ -173,10 +161,79 @@ class StreamingHost:
             histograms=HISTOGRAMS,
             health=self.health,
         )
+        # machine-profile calibration (obs/calibrate.py): ~100 ms of
+        # jit micro-probes, process-cached and persisted/shared like
+        # the compile cache (observability.calibrationfile /
+        # calibrationurl). The profile prices the conf-embedded
+        # byte+FLOP model into the DX520/DX521 roofline predictions and
+        # exports as the Calib_* series on every batch. Off with
+        # observability.calibration=false (the monitor's latency checks
+        # then stay disarmed unless conformance.latency pins them).
+        self._calib_metrics: Dict[str, float] = {}
+        if (obs_conf.get_or_else("calibration", "true") or "").lower() \
+                != "false":
+            from ..obs.calibrate import get_profile
+
+            try:
+                profile = get_profile(
+                    cache_file=obs_conf.get("calibrationfile"),
+                    share_url=obs_conf.get("calibrationurl"),
+                )
+                self._calib_metrics = profile.metrics()
+                if self.conformance is not None \
+                        and not self.conformance.latency_pinned:
+                    preds, compute_ms, overhead_ms = (
+                        self.conformance.model.latency_predictions(
+                            profile.to_dict()
+                        )
+                    )
+                    self.conformance.set_latency(
+                        preds, compute_ms, overhead_ms
+                    )
+            except Exception:  # noqa: BLE001 — calibration is optional
+                logger.exception(
+                    "machine-profile calibration failed; "
+                    "DX52x latency checks disarmed"
+                )
+
+        # live HBM watermark sampling (observability.hbmsample, default
+        # on): each batch finish samples the device allocator
+        # (memory_stats) into Hbm_BytesInUse/Hbm_PeakBytes — the DX522
+        # observation. Silently absent on backends that don't report
+        # (CPU), exactly like a missing conformance prediction.
+        self.hbm_sample = (
+            (obs_conf.get_or_else("hbmsample", "true") or "").lower()
+            != "false"
+        )
+
+        # on-demand profiler surface (obs/profiler.py): POST
+        # /profile?seconds=N on the observability port arms a
+        # jax.profiler capture that lands beside the flight recorder
+        # (observability.profilerdir overrides). Off with
+        # observability.profiler=false.
+        self.profiler = None
+        if (obs_conf.get_or_else("profiler", "true") or "").lower() \
+                != "false":
+            from ..obs.profiler import ProfilerSurface
+
+            prof_dir = obs_conf.get("profilerdir")
+            if not prof_dir:
+                tracefile = dict_.get_sub_dictionary(
+                    "datax.job.process.telemetry."
+                ).get("tracefile")
+                base = (
+                    os.path.dirname(os.path.abspath(tracefile))
+                    if tracefile else tempfile.gettempdir()
+                )
+                prof_dir = os.path.join(
+                    base, f"profiler-{dict_.get_job_name() or 'flow'}"
+                )
+            self.profiler = ProfilerSurface(
+                prof_dir, flow=dict_.get_job_name()
+            )
+
         self.obs_server: Optional[ObservabilityServer] = None
-        obs_port = dict_.get_sub_dictionary(
-            SettingNamespace.JobProcessPrefix + "observability."
-        ).get_int_option("port")
+        obs_port = obs_conf.get_int_option("port")
         if obs_port is not None:
             self.obs_server = ObservabilityServer(
                 self.health,
@@ -184,6 +241,7 @@ class StreamingHost:
                 store=self.metric_logger.store,
                 port=obs_port,
                 alerts=self.alerts,
+                profiler=self.profiler,
             )
             self.obs_server.start()
 
@@ -514,6 +572,35 @@ class StreamingHost:
             metrics["Transfer_Background_Pending"] = float(backlog)
             metrics["Transfer_Background_LandMs"] = land_ms
         self.health.record_stall(stall_ms)
+        # the calibrated machine profile rides every batch as Calib_*
+        # gauges (constant per process — dashboards see the machine
+        # model their roofline ratios are judged against)
+        if self._calib_metrics:
+            metrics.update(self._calib_metrics)
+        # live HBM watermark (DX522's observation): the device
+        # allocator's in-use/peak bytes, absent on backends that don't
+        # report memory stats
+        if self.hbm_sample:
+            hbm = self.processor.device_memory_stats()
+            if hbm is not None:
+                metrics["Hbm_BytesInUse"] = float(
+                    hbm.get("bytes_in_use") or 0.0
+                )
+                metrics["Hbm_PeakBytes"] = float(
+                    hbm.get("peak_bytes_in_use") or 0.0
+                )
+        # per-stage latency percentiles from the live histograms — the
+        # DATAX-<flow>:Latency-<Stage>-pNN series the dashboard's stat
+        # tiles and stage timechart read (obs/histogram.py keeps these
+        # exact over a bounded recent-sample window). Merged BEFORE the
+        # conformance pass: the DX520 stage-time check judges the same
+        # p50 series the dashboards render.
+        for stage in MetricName.STAGES:
+            stem = MetricName.stage_metric(stage)
+            for q in (50, 95, 99):
+                v = HISTOGRAMS.percentile(self.health.flow, stage, q)
+                if v is not None:
+                    metrics[f"{stem}-p{q}"] = v
         # model-vs-observed conformance: ratio gauges join this batch's
         # metrics; drift transitions become typed flight-recorder events
         # and store rows (obs/conformance.py)
@@ -531,16 +618,19 @@ class StreamingHost:
                 logger.warning(
                     "conformance drift %s: %s", ev.code, ev.message
                 )
-        # per-stage latency percentiles from the live histograms — the
-        # DATAX-<flow>:Latency-<Stage>-pNN series the dashboard's stat
-        # tiles and stage timechart read (obs/histogram.py keeps these
-        # exact over a bounded recent-sample window)
-        for stage in MetricName.STAGES:
-            stem = MetricName.stage_metric(stage)
-            for q in (50, 95, 99):
-                v = HISTOGRAMS.percentile(self.health.flow, stage, q)
-                if v is not None:
-                    metrics[f"{stem}-p{q}"] = v
+        # finished profiler captures stitch into THIS batch's trace as
+        # span events (the capture path is then one `obs trace` away
+        # from the batches it overlapped) and bump the capture counter
+        if self.profiler is not None:
+            for cap in self.profiler.drain_finished():
+                trace.record(
+                    "profiler/capture", cap["startedTs"],
+                    cap.get("durationMs") or 0.0, path=cap["path"],
+                )
+            if self.profiler.captures_count:
+                metrics["Profiler_Captures_Count"] = float(
+                    self.profiler.captures_count
+                )
         self.telemetry.batch_end(batch_time_ms, {"latencyMs": metrics["Latency-Batch"]})
         self.metric_logger.send_batch_metrics(metrics, batch_time_ms)
         # alert evaluation AFTER the store flush so window aggregates
@@ -581,28 +671,6 @@ class StreamingHost:
         trace.end()
         return metrics
 
-    def _profiler_tick(self) -> None:
-        """Trace the first N batches into profilerdir (jax profiler —
-        view with tensorboard/xprof; replaces AppInsights' profiler)."""
-        if not self._profiler_dir:
-            return
-        import jax
-
-        with self._profiler_lock:
-            if not self._profiling and self.batches_processed == 0:
-                jax.profiler.start_trace(self._profiler_dir)
-                self._profiling = True
-                logger.info("jax profiler tracing -> %s", self._profiler_dir)
-            elif (
-                self._profiling
-                and self.batches_processed >= self._profiler_batches
-            ):
-                jax.profiler.stop_trace()
-                self._profiling = False
-                logger.info(
-                    "jax profiler trace written to %s", self._profiler_dir
-                )
-
     def _traced_poll(self, trace):
         """Poll + encode under the batch's trace (the pipelined loop
         runs this on the decode-ahead worker thread, so the span needs
@@ -624,7 +692,6 @@ class StreamingHost:
         """Poll + encode + dispatch one batch; a failure anywhere here
         (bad payload, re-trace error) requeues the polled batch so a
         later batch's ack can't release it unprocessed."""
-        self._profiler_tick()
         trace = self.tracer.begin("streaming/batch")
         try:
             raw, consumed, batch_time_ms, t0 = self._traced_poll(trace)
@@ -674,6 +741,12 @@ class StreamingHost:
                     time.sleep(sleep)
         finally:
             self._stop_profiler()
+
+    def _stop_profiler(self) -> None:
+        """Close any in-flight on-demand capture so its trace flushes
+        before the loop (or the process) goes away."""
+        if self.profiler is not None:
+            self.profiler.stop()
 
     def run_pipelined(
         self,
@@ -751,7 +824,6 @@ class StreamingHost:
                 if max_batches is not None and started >= max_batches:
                     break
                 iter_t0 = time.time()
-                self._profiler_tick()
                 if fut is None:
                     fut_trace = self.tracer.begin("streaming/batch")
                     fut = pool.submit(self._traced_poll, fut_trace)
@@ -824,18 +896,6 @@ class StreamingHost:
                 fut_trace.end(status="aborted")  # idempotent
             pool.shutdown(wait=False, cancel_futures=True)
             self._stop_profiler()
-
-    def _stop_profiler(self) -> None:
-        """Flush the jax trace if still recording (loop ended early)."""
-        with self._profiler_lock:
-            if self._profiling:
-                import jax
-
-                jax.profiler.stop_trace()
-                self._profiling = False
-                logger.info(
-                    "jax profiler trace written to %s", self._profiler_dir
-                )
 
     def stop(self, close_sources: bool = True) -> None:
         """``close_sources=False`` tears the host down but leaves its

@@ -1859,6 +1859,32 @@ class FlowProcessor:
         for st in self.state_tables.values():
             st.persist()
 
+    def device_memory_stats(self) -> Optional[Dict[str, int]]:
+        """The device allocator's live watermark — ``bytes_in_use`` /
+        ``peak_bytes_in_use`` from ``memory_stats()`` of the device the
+        step runs on (the first mesh device under a mesh). None when
+        the backend doesn't report (CPU) — the host's Hbm_* sampler and
+        the DX522 conformance check then stay silent."""
+        try:
+            if self.mesh is not None:
+                dev = self.mesh.devices.flat[0]
+            else:
+                import jax
+
+                dev = jax.local_devices()[0]
+            stats = dev.memory_stats()
+        except Exception:  # noqa: BLE001 — sampling is diagnostics only
+            return None
+        if not stats:
+            return None
+        return {
+            "bytes_in_use": int(stats.get("bytes_in_use") or 0),
+            "peak_bytes_in_use": int(
+                stats.get("peak_bytes_in_use")
+                or stats.get("bytes_in_use") or 0
+            ),
+        }
+
 
 def _host_sort(rows: List[dict], order: List[Tuple[str, bool]]) -> None:
     """Stable multi-key in-place sort matching SQL semantics: ascending

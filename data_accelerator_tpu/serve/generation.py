@@ -608,6 +608,13 @@ class RuntimeConfigGeneration:
             ("jobPilotMaxDepth", "pilot.maxdepth"),
             ("jobPilotMaxReplicas", "pilot.maxreplicas"),
             ("jobStallEwmaMs", "observability.stallewmams"),
+            # PR 12 time-model surface: the on-demand profiler endpoint,
+            # the per-batch HBM watermark sampler and machine-profile
+            # calibration (all default ON in the host; these designer
+            # knobs exist to turn one off per job)
+            ("jobProfiler", "observability.profiler"),
+            ("jobHbmSample", "observability.hbmsample"),
+            ("jobCalibration", "observability.calibration"),
         ):
             v = jobconf.get(gui_key)
             if v not in (None, ""):

@@ -144,6 +144,13 @@ route("#/flow/", async (view, hash) => {
   const renderCostTable = (dev) => {
     if (!dev || !dev.stages || !dev.stages.length) return null;
     const t = dev.totals || {};
+    /* roofline latency model (analysis/costmodel.py latency_model):
+       per-stage predicted ms + the deviceStep/d2h decomposition; the
+       per-stage column joins by stage name */
+    const lm = dev.latencyModel || {};
+    const lmStageMs = {};
+    for (const s of lm.stages || []) lmStageMs[s.name] = s.computeMs;
+    const lt = lm.totals || {};
     return h("div", { class: "cost" },
       h("div", { class: "muted" },
         `device plan @ ${dev.chips} chips — HBM ${fmtBytes(t.hbmBytes || 0)}` +
@@ -151,11 +158,15 @@ route("#/flow/", async (view, hash) => {
         ` ICI ${fmtBytes(t.iciBytesPerBatch || 0)}/batch,` +
         ` D2H ${fmtBytes(t.d2hBytesPerBatch || 0)}/batch,` +
         ` ~${fmtVal(t.flops || 0)} FLOP/batch`),
+      lt.batchMs != null ? h("div", { class: "muted" },
+        `roofline latency (${lm.profileSource} profile): device step ` +
+        `${fmtVal(lt.deviceStepMs)} ms + D2H ${fmtVal(lt.d2hMs || 0)} ms` +
+        ` = ${fmtVal(lt.batchMs)} ms/batch (lower bound)`) : null,
       h("table", { class: "grid cost-table" },
         h("thead", {}, h("tr", {},
           h("th", {}, "stage"), h("th", {}, "kind"), h("th", {}, "rows"),
           h("th", {}, "HBM"), h("th", {}, "FLOPs"), h("th", {}, "ICI/batch"),
-          h("th", {}, "D2H/batch"))),
+          h("th", {}, "D2H/batch"), h("th", {}, "roofline ms"))),
         h("tbody", {}, dev.stages.map((s) => h("tr", {},
           h("td", { class: "mono" }, s.name),
           h("td", {}, s.kind),
@@ -163,7 +174,9 @@ route("#/flow/", async (view, hash) => {
           h("td", { class: "num" }, fmtBytes(s.hbmBytes)),
           h("td", { class: "num" }, s.flops ? fmtVal(s.flops) : "–"),
           h("td", { class: "num" }, s.iciBytes ? fmtBytes(s.iciBytes) : "–"),
-          h("td", { class: "num" }, s.d2hBytes ? fmtBytes(s.d2hBytes) : "–"))))));
+          h("td", { class: "num" }, s.d2hBytes ? fmtBytes(s.d2hBytes) : "–"),
+          h("td", { class: "num" },
+            lmStageMs[s.name] != null ? fmtVal(lmStageMs[s.name]) : "–"))))));
   };
   const renderPlacement = (f) => {
     // fleet tier (flow/validate fleet: true): placement plan of this
@@ -878,6 +891,31 @@ route("#/metrics", async (view, hash) => {
   const pilotSection = h("div", { style: "display:none" },
     h("h2", {}, "Autopilot"), pilotTiles);
   view.append(pilotSection);
+
+  /* time-model tile row (PR 12 roofline conformance): live HBM
+     watermark vs the DX2xx footprint, the DX520 device-step ratio
+     against the calibrated roofline, and on-demand profiler captures —
+     hidden until the host emits any of the series */
+  const TIMEMODEL_METRICS = [
+    ["Hbm_BytesInUse", "HBM in use (B)"],
+    ["Hbm_PeakBytes", "HBM peak (B)"],
+    ["Conformance_Hbm_Ratio", "HBM vs model"],
+    ["Conformance_StageTime_DeviceStep_Ratio", "device-step vs roofline"],
+    ["Calib_DispatchOverheadUs", "dispatch overhead (µs)"],
+    ["Profiler_Captures_Count", "profiler captures"],
+  ];
+  const tmTiles = h("div", { class: "tiles" });
+  const tmEls = {};
+  for (const [metric, label] of TIMEMODEL_METRICS) {
+    const tile = h("div", { class: "tile" },
+      h("div", { class: "k" }, label),
+      h("div", { class: "v" }, "–"));
+    tmTiles.append(tile);
+    tmEls[metric] = $(".v", tile);
+  }
+  const tmSection = h("div", { style: "display:none" },
+    h("h2", {}, "Time model"), tmTiles);
+  view.append(tmSection);
   const stageChartBox = h("div", {});
   view.append(stageChartBox);
   const STAGE_PCTL = "p95";
@@ -904,6 +942,11 @@ route("#/metrics", async (view, hash) => {
     if (pilotEls[metric]) {
       pilotSection.style.display = "";
       pilotEls[metric].textContent = fmtVal(point.val);
+      return true;
+    }
+    if (tmEls[metric]) {
+      tmSection.style.display = "";
+      tmEls[metric].textContent = fmtVal(point.val);
       return true;
     }
     if (stageKeyOf[metric]) {

@@ -394,7 +394,12 @@ def test_json_reports_pin_schema_version_and_keys(tmp_path):
     out = json.loads(_run_cli(["--json", "--device", "--udfs", path]).stdout)
     assert out["schemaVersion"] == REPORT_SCHEMA_VERSION
     assert set(out) == base_keys | {"file", "device", "udfs"}
-    assert set(out["device"]) == {"flow", "chips", "stages", "totals"}
+    assert set(out["device"]) == {
+        "flow", "chips", "stages", "totals", "latencyModel"
+    }
+    assert set(out["device"]["latencyModel"]) == {
+        "profileSource", "profile", "stages", "totals"
+    }
 
     # fleet tier
     out = json.loads(_run_cli(["--json", "--fleet", path]).stdout)
@@ -410,7 +415,7 @@ def test_json_reports_pin_schema_version_and_keys(tmp_path):
     assert out["schemaVersion"] == REPORT_SCHEMA_VERSION
     assert set(out) == base_keys | {"file", "mesh"}
     assert set(out["mesh"]) == {
-        "flow", "chips", "validated", "stages", "totals"
+        "flow", "chips", "validated", "stages", "totals", "latencyModel"
     }
     assert set(out["mesh"]["totals"]) == {
         "iciResultBytesPerBatch", "iciWireBytesPerBatch", "reshardCount",

@@ -165,6 +165,31 @@ def test_conformance_and_alert_metrics_are_registered():
     assert not MetricName.is_runtime_metric("Alerts_Bogus")
 
 
+def test_timemodel_metrics_are_registered():
+    """The PR 12 roofline/time-model series resolve through the
+    registry: the calibrated machine profile (Calib_*), the live HBM
+    watermark sampler, the on-demand profiler counter, and the
+    DX520/DX522 conformance ratio gauges."""
+    for m in (
+        "Calib_HbmReadGBps",
+        "Calib_HbmWriteGBps",
+        "Calib_FlopsGFlops",
+        "Calib_DispatchOverheadUs",
+        "Calib_D2HGBps",
+        "Calib_IciGBps",
+        "Hbm_BytesInUse",
+        "Hbm_PeakBytes",
+        "Profiler_Captures_Count",
+        "Conformance_StageTime_DeviceStep_Ratio",
+        "Conformance_StageTime_Collect_Ratio",
+        "Conformance_Hbm_Ratio",
+    ):
+        assert MetricName.is_runtime_metric(m), m
+    assert not MetricName.is_runtime_metric("Calib_Bogus")
+    assert not MetricName.is_runtime_metric("Hbm_Bogus")
+    assert not MetricName.is_runtime_metric("Conformance_StageTime_Ratio")
+
+
 def test_background_transfer_metrics_are_registered():
     """The device-resident result path's series (runtime/processor.py
     collect_counts/collect_tables + runtime/host.py background landing)

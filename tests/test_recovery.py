@@ -424,17 +424,25 @@ def test_udf_refresh_mid_window_uses_snapshotted_pipeline(tmp_path):
 
 
 def test_profiler_hook_writes_trace(tmp_path):
+    """On-demand profiler surface (obs/profiler.py, the first-N-batches
+    dump's replacement): arming a capture on a live host writes a
+    loadable jax trace under the capture dir, and the finished capture
+    drains into the next batch's trace as a profiler/capture span."""
     prof_dir = tmp_path / "prof"
     _write_events(str(tmp_path / "in" / "a.json"),
                   [{"k": 1, "v": 1.0}, {"k": 2, "v": 2.0}])
     host = StreamingHost(_conf(tmp_path, {
-        "datax.job.process.telemetry.profilerdir": str(prof_dir),
-        "datax.job.process.telemetry.profilerbatches": "1",
+        "datax.job.process.observability.profilerdir": str(prof_dir),
     }))
+    assert host.profiler is not None and host.profiler.available
+    res = host.profiler.start(seconds=60)  # stopped explicitly below
+    assert res.get("path"), res
     host.run_batch()
-    host.run_batch()  # second batch crosses the stop threshold
+    host.profiler.stop()
+    host.run_batch()  # drains the capture into this batch's trace
+    assert host.profiler.captures_count == 1
     host.stop()
     traces = []
-    for root, _d, files in os.walk(prof_dir):
+    for root, _d, files in os.walk(res["path"]):
         traces += [f for f in files if "trace" in f or f.endswith(".pb")]
-    assert traces, f"no profiler trace written under {prof_dir}"
+    assert traces, f"no profiler trace written under {res['path']}"
