@@ -511,6 +511,138 @@ def bench_cold_start(capacity=None):
     }
 
 
+def bench_state_handoff():
+    """Elastic stateful rescale acceptance block: the stop→successor-
+    first-batch time of a partition handoff. A predecessor runs a
+    stateful TIMEWINDOW + accumulator flow with its partitions
+    mirrored through a live object store; the successor (fresh local
+    dirs — the mirror is its only route to state) pulls its assigned
+    partitions, merges the window rings, reloads the accumulators and
+    processes its first batch. ``stop_to_first_batch_ms`` is the
+    handoff number the tentpole promises sub-second warm; the
+    breakdown separates processor init (compile — the AOT/persistent-
+    cache domain, see ``cold_start``) from the state pull+restore that
+    is THIS feature's cost."""
+    import shutil
+    import tempfile
+
+    from data_accelerator_tpu.core.config import SettingDictionary
+    from data_accelerator_tpu.runtime.host import StreamingHost
+    from data_accelerator_tpu.runtime.sources import LocalSource
+    from data_accelerator_tpu.serve.objectstore import ObjectStoreServer
+
+    wd = tempfile.mkdtemp(prefix="dxtpu-bench-handoff-")
+    store = ObjectStoreServer(port=0).start()  # in-memory
+    schema = json.dumps({"type": "struct", "fields": [
+        {"name": "k", "type": "long", "nullable": False, "metadata": {}},
+        {"name": "v", "type": "double", "nullable": False, "metadata": {}},
+    ]})
+    tpath = os.path.join(wd, "handoff.transform")
+    with open(tpath, "w", encoding="utf-8") as f:
+        f.write(
+            "--DataXQuery--\n"
+            "merged = SELECT k, v FROM DataXProcessedInput "
+            "UNION ALL SELECT k, v FROM seen\n"
+            "--DataXQuery--\n"
+            "seen = SELECT k, MAX(v) AS v FROM merged GROUP BY k\n"
+            "--DataXQuery--\n"
+            "Win = SELECT k, COUNT(*) AS c "
+            "FROM DataXProcessedInput_10seconds GROUP BY k\n"
+        )
+
+    def conf(hostdir, replica_index=1, replica_count=1):
+        return SettingDictionary({
+            "datax.job.name": "BenchHandoff",
+            "datax.job.input.default.inputtype": "local",
+            "datax.job.input.default.blobschemafile": schema,
+            "datax.job.input.default.eventhub.maxrate": "1024",
+            "datax.job.input.default.eventhub.checkpointdir": os.path.join(
+                hostdir, "ckpt"
+            ),
+            "datax.job.input.default.eventhub.checkpointinterval":
+                "0 second",
+            "datax.job.input.default.streaming.intervalinseconds": "1",
+            "datax.job.process.timestampcolumn": "ts",
+            "datax.job.process.watermark": "0 second",
+            "datax.job.process.transform": tpath,
+            "datax.job.process.batchcapacity": "1024",
+            "datax.job.process.timewindow.DataXProcessedInput_10seconds"
+            ".windowduration": "10 seconds",
+            "datax.job.process.statetable.seen.schema": "k long, v double",
+            "datax.job.process.statetable.seen.location": os.path.join(
+                hostdir, "state", "seen"
+            ),
+            "datax.job.process.state.partitions": "16",
+            "datax.job.process.state.partitionkey": "k",
+            "datax.job.process.state.replicaindex": str(replica_index),
+            "datax.job.process.state.replicacount": str(replica_count),
+            "datax.job.process.state.snapshoturl":
+                f"objstore://127.0.0.1:{store.port}/bench/handoff",
+            # the successor warms its compiles from the SHARED
+            # persistent cache (the PR 9 path a real rescale uses), so
+            # the handoff number measures state movement, not XLA
+            "datax.job.process.compile.cachedir": os.path.join(
+                wd, "compile-cache"
+            ),
+            "datax.job.process.pilot.enabled": "false",
+            "datax.job.process.observability.calibration": "false",
+            "datax.job.output.Win.console.maxrows": "0",
+        })
+
+    class _NullSink:
+        kind = "null"
+
+        def write(self, dataset, rows, batch_time_ms):
+            return len(rows)
+
+    def quiet(host):
+        for op in host.dispatcher.operators.values():
+            op.sinks = [_NullSink()]
+        return host
+
+    try:
+        pred = quiet(StreamingHost(conf(os.path.join(wd, "pred"))))
+        for _ in range(3):
+            pred.run_batch()
+        t_stop = time.perf_counter()
+        pred.stop()
+        stop_ms = (time.perf_counter() - t_stop) * 1000.0
+
+        t0 = time.perf_counter()
+        succ = quiet(StreamingHost(conf(os.path.join(wd, "succ"))))
+        init_ms = (time.perf_counter() - t0) * 1000.0
+        # read before the first collect drains state_stats into metrics
+        state_pull_ms = succ.processor.state_stats.get("Handoff_Ms")
+        t1 = time.perf_counter()
+        succ.run_batch()
+        first_batch_ms = (time.perf_counter() - t1) * 1000.0
+        handoff_ms = (time.perf_counter() - t_stop) * 1000.0
+        restored = succ.window_restored_from
+        succ.stop()
+        # restore the process-global jax cache config in reverse enable
+        # order (the shared dir is deleted below)
+        for h in (succ, pred):
+            if h.processor._compile_cache is not None:
+                h.processor._compile_cache.disable()
+        return {
+            "stop_ms": round(stop_ms, 1),
+            "successor_init_ms": round(init_ms, 1),
+            "state_pull_restore_ms": (
+                round(state_pull_ms, 1) if state_pull_ms is not None
+                else None
+            ),
+            "successor_first_batch_ms": round(first_batch_ms, 1),
+            "stop_to_first_batch_ms": round(handoff_ms, 1),
+            "window_restored_from": restored,
+            # the acceptance bit: a warm handoff (state follows the
+            # replicas through the store) stays sub-second
+            "sub_second": handoff_ms < 1000.0,
+        }
+    finally:
+        store.stop()
+        shutil.rmtree(wd, ignore_errors=True)
+
+
 def bench_pilot_overhead(iters=2000):
     """Autopilot hot-path overhead block: the pilot rides the dispatch
     loop (``tick`` per iteration, ``admit_events`` + ``observe_poll``
@@ -810,6 +942,7 @@ def main():
             "collect": med["collect"],
         }),
         "cold_start": bench_cold_start(),
+        "state_handoff": bench_state_handoff(),
         "pilot": bench_pilot_overhead(),
     }
     reg = regression_gate(result)

@@ -150,17 +150,27 @@ class PersistentCompileCache:
         return f"{self._prefix}/{fn}" if self._prefix else fn
 
     def pull(self) -> int:
-        """Download shared entries absent locally. Best-effort: a dead
-        store degrades to the local-only cache."""
+        """Download shared entries absent locally. FAIL-OPEN: the
+        client retries transient failures with bounded jittered backoff
+        (serve/objectstore.py), and whatever still fails degrades to
+        the local-only cache — a cold compile beats a dead host. Each
+        entry fails independently so one bad object can't abort the
+        rest of the pull (contrast the state-snapshot store, which is
+        fail-closed: runtime/statepartition.py)."""
         if self._client is None:
             return 0
         n = 0
         try:
             have = set(self._entries())
-            for key in self._client.list(self._prefix):
-                fn = key.rsplit("/", 1)[-1]
-                if fn in have or fn.endswith("-atime"):
-                    continue
+            keys = self._client.list(self._prefix)
+        except Exception as e:  # noqa: BLE001 — shared layer is best-effort
+            logger.warning("compile-cache pull failed: %s", e)
+            return 0
+        for key in keys:
+            fn = key.rsplit("/", 1)[-1]
+            if fn in have or fn.endswith("-atime"):
+                continue
+            try:
                 data = self._client.get(key)
                 if data is None:
                     continue
@@ -170,8 +180,8 @@ class PersistentCompileCache:
                     f.write(data)
                 os.replace(tmp, path)
                 n += 1
-        except Exception as e:  # noqa: BLE001 — shared layer is best-effort
-            logger.warning("compile-cache pull failed: %s", e)
+            except Exception as e:  # noqa: BLE001 — best-effort per entry
+                logger.warning("compile-cache pull %s failed: %s", fn, e)
         return n
 
     def push(self) -> int:

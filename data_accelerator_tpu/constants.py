@@ -190,6 +190,23 @@ class MetricName:
         r"Pilot_Suppressed_Count",
         r"Pilot_Depth",
         r"Pilot_Backpressure_Tokens",
+        # partitioned state & rescale (runtime/statetable.py +
+        # runtime/statepartition.py, drained at collect; the
+        # Partition_Reassigned count is emitted under DATAX-Fleet by
+        # JobOperation.rescale): partition geometry this replica runs,
+        # successor handoff cost (state pull + restore at init),
+        # corrupt-snapshot fallbacks (DX530/531), snapshot pushes/pulls
+        # through the objstore mirror, rows the key-routed ingest
+        # filter dropped as un-owned, and window rows dropped when a
+        # merge overflowed a ring slot
+        r"State_Partition_Count",
+        r"State_Partition_Owned",
+        r"State_Partition_Reassigned_Count",
+        r"State_Handoff_Ms",
+        r"State_LoadFallback_Count",
+        r"State_Snapshot_(Push|Pull)_Count",
+        r"State_IngestFiltered_Count",
+        r"State_WindowRows_Dropped_Count",
         # fleet placement (serve/jobs.py FleetAdmissionGate, emitted
         # under the DATAX-Fleet app on every admission check / re-plan):
         # fleet-wide chip/flow counts, per-chip packed HBM and

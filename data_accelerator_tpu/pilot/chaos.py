@@ -168,6 +168,110 @@ class DeviceSlowdownInjector(Injector):
         self._host = None
 
 
+class PartitionLossInjector(Injector):
+    """Corrupt a state-partition snapshot MID-HANDOFF: between a
+    predecessor's stop and the successor's first load, the ACTIVE
+    side's snapshot for one (or every) partition is truncated or
+    replaced with garbage — the torn-write / lost-object failure a
+    rescale can meet in the wild. The successor's loader must fall
+    back to the STANDBY side (DX530, ``State_LoadFallback_Count``) —
+    or load the partition empty when both sides are gone (DX531) — and
+    at-least-once replay of the un-acked window re-aggregates what the
+    standby was missing.
+
+    Targets either the local partition layout (``location=`` — a state
+    table's dir) or the shared objstore mirror (``store_url=`` — what a
+    cross-host successor actually pulls). ``table`` selects the prefix
+    (a state-table name, or ``__window__`` for ring snapshots);
+    ``partition=None`` corrupts every partition that has a pointer."""
+
+    name = "partition-loss"
+    _GARBAGE = b"\x00\xffPK-not-an-npz\x00truncated"
+
+    def __init__(self, location: Optional[str] = None,
+                 store_url: Optional[str] = None,
+                 table: str = "", partition: Optional[int] = None,
+                 mode: str = "truncate", filename: str = "table.npz"):
+        if (location is None) == (store_url is None):
+            raise ValueError("exactly one of location/store_url required")
+        self.location = location
+        self.store_url = store_url
+        self.table = table
+        self.partition = partition
+        self.mode = mode
+        self.filename = filename
+        self.corrupted: List[str] = []
+
+    # the stop->successor gap has no live host; arm/disarm keep the
+    # Injector seam contract for drills that hold one anyway
+    def arm(self, host) -> None:
+        self.corrupt()
+
+    def disarm(self) -> None:
+        pass
+
+    def _payload(self, original: Optional[bytes]) -> bytes:
+        if self.mode == "truncate" and original:
+            return original[: max(1, len(original) // 3)]
+        return self._GARBAGE
+
+    def corrupt(self) -> List[str]:
+        """Apply the corruption; returns the snapshot paths/keys hit."""
+        import os
+
+        self.corrupted = []
+        if self.location is not None:
+            from ..runtime.statepartition import LocalSnapshotStore
+
+            store = LocalSnapshotStore(self.location)
+            prefixes = (
+                [f"p{self.partition:02d}"] if self.partition is not None
+                else sorted(
+                    d for d in os.listdir(self.location)
+                    if d.startswith("p") and os.path.isdir(
+                        os.path.join(self.location, d))
+                )
+            )
+            for prefix in prefixes:
+                side = store.get_pointer(prefix)
+                if side is None:
+                    continue
+                path = os.path.join(self.location, prefix, side,
+                                    self.filename)
+                if not os.path.exists(path):
+                    continue
+                with open(path, "rb") as f:
+                    original = f.read()
+                with open(path, "wb") as f:
+                    f.write(self._payload(original))
+                self.corrupted.append(path)
+            return self.corrupted
+
+        from ..compile.aotcache import _parse_objstore_url
+        from ..serve.objectstore import ObjectStoreClient
+
+        endpoint, bucket, root = _parse_objstore_url(self.store_url)
+        client = ObjectStoreClient(endpoint, bucket)
+        base = f"{root}/{self.table}" if root else self.table
+        parts = (
+            [self.partition] if self.partition is not None
+            else range(64)
+        )
+        for p in parts:
+            pkey = f"{base}/p{int(p):02d}"
+            pointer = client.get(f"{pkey}/pointer")
+            if pointer is None:
+                continue
+            side = pointer.decode().strip()
+            key = f"{pkey}/{side}/{self.filename}"
+            original = client.get(key)
+            if original is None:
+                continue
+            client.put(key, self._payload(original))
+            self.corrupted.append(key)
+        return self.corrupted
+
+
 # ---------------------------------------------------------------------------
 # Harness pieces the scenario suite (and tests) assert against
 # ---------------------------------------------------------------------------

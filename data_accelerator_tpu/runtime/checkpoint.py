@@ -34,6 +34,62 @@ def _durable_replace(tmp: str, dst: str) -> None:
         os.close(dir_fd)
 
 
+def snapshot_arrays(snap: Dict) -> Dict:
+    """Flatten a window-state snapshot dict
+    (``FlowProcessor.snapshot_window_state`` shape) into the named
+    numpy arrays one ``np.savez`` call persists. Shared by the
+    whole-file checkpoint below and the per-partition payloads the
+    state-partition stores ship (runtime/statepartition.py)."""
+    import json as _json
+
+    import numpy as np
+
+    arrays: Dict = {}
+    for table, ring in snap.get("rings", {}).items():
+        for c, a in ring["cols"].items():
+            arrays[f"ring/{table}/col/{c}"] = a
+        arrays[f"ring/{table}/valid"] = ring["valid"]
+    arrays["slot_counter"] = np.asarray(int(snap.get("slot_counter", 0)),
+                                        np.int64)
+    base = snap.get("base_ms")
+    arrays["base_ms"] = np.asarray(-1 if base is None else int(base),
+                                   np.int64)
+    if snap.get("dictionary") is not None:
+        # ring ids are meaningless without the dictionary that encoded
+        # them; ride it along as JSON bytes
+        arrays["dictionary_json"] = np.frombuffer(
+            _json.dumps(snap["dictionary"]).encode("utf-8"), dtype=np.uint8
+        )
+    return arrays
+
+
+def arrays_to_snapshot(z) -> Dict:
+    """Inverse of ``snapshot_arrays`` over a loaded npz mapping."""
+    import json as _json
+
+    rings: Dict[str, Dict] = {}
+    for key in z.files:
+        if not key.startswith("ring/"):
+            continue
+        _, table, kind = key.split("/", 2)
+        ring = rings.setdefault(table, {"cols": {}, "valid": None})
+        if kind == "valid":
+            ring["valid"] = z[key]
+        else:
+            ring["cols"][kind.split("/", 1)[1]] = z[key]
+    base = int(z["base_ms"])
+    out = {
+        "rings": rings,
+        "slot_counter": int(z["slot_counter"]),
+        "base_ms": None if base < 0 else base,
+    }
+    if "dictionary_json" in z.files:
+        out["dictionary"] = _json.loads(
+            z["dictionary_json"].tobytes().decode("utf-8")
+        )
+    return out
+
+
 @dataclass(frozen=True)
 class PartitionOffset:
     ts_ms: int
@@ -162,26 +218,7 @@ class WindowStateCheckpointer:
     def _save(self, snap: Dict) -> None:
         import numpy as np
 
-        arrays: Dict[str, "np.ndarray"] = {}
-        for table, ring in snap.get("rings", {}).items():
-            for c, a in ring["cols"].items():
-                arrays[f"ring/{table}/col/{c}"] = a
-            arrays[f"ring/{table}/valid"] = ring["valid"]
-        arrays["slot_counter"] = np.asarray(
-            int(snap.get("slot_counter", 0)), np.int64
-        )
-        base = snap.get("base_ms")
-        arrays["base_ms"] = np.asarray(
-            -1 if base is None else int(base), np.int64
-        )
-        if snap.get("dictionary") is not None:
-            # ring ids are meaningless without the dictionary that
-            # encoded them; ride it along as JSON bytes
-            import json as _json
-
-            arrays["dictionary_json"] = np.frombuffer(
-                _json.dumps(snap["dictionary"]).encode("utf-8"), dtype=np.uint8
-            )
+        arrays = snapshot_arrays(snap)
         if os.path.exists(self.path):
             shutil.copyfile(self.path, self.backup_path)
         tmp = self.path + ".tmp"
@@ -193,7 +230,9 @@ class WindowStateCheckpointer:
 
     def load(self) -> Optional[Dict]:
         """Restore a snapshot dict, falling back to the backup; None when
-        no (readable) snapshot exists."""
+        no (readable) snapshot exists — including when a crash left only
+        a torn ``window.npz.tmp`` behind (the tmp is never read; the
+        previous complete checkpoint wins)."""
         import numpy as np
 
         for path in (self.path, self.backup_path):
@@ -201,31 +240,7 @@ class WindowStateCheckpointer:
                 continue
             try:
                 with np.load(path) as z:
-                    rings: Dict[str, Dict] = {}
-                    for key in z.files:
-                        if not key.startswith("ring/"):
-                            continue
-                        _, table, kind = key.split("/", 2)
-                        ring = rings.setdefault(
-                            table, {"cols": {}, "valid": None}
-                        )
-                        if kind == "valid":
-                            ring["valid"] = z[key]
-                        else:
-                            ring["cols"][kind.split("/", 1)[1]] = z[key]
-                    base = int(z["base_ms"])
-                    out = {
-                        "rings": rings,
-                        "slot_counter": int(z["slot_counter"]),
-                        "base_ms": None if base < 0 else base,
-                    }
-                    if "dictionary_json" in z.files:
-                        import json as _json
-
-                        out["dictionary"] = _json.loads(
-                            z["dictionary_json"].tobytes().decode("utf-8")
-                        )
-                    return out
+                    return arrays_to_snapshot(z)
             except Exception:
                 continue
         return None

@@ -249,16 +249,39 @@ class StreamingHost:
             positions = self.checkpointer.starting_positions()
             for s in self.sources.values():
                 s.start(positions)
+        # window restore, local first: a plain restart reloads its own
+        # window.npz; a RESCALE SUCCESSOR (fresh dirs, objstore mirror
+        # configured) pulls only the window partitions its replica
+        # index owns and merges them — the handoff path
+        self.window_restored_from: Optional[str] = None
         if self.window_checkpointer:
             snap = self.window_checkpointer.load()
             if snap is not None:
                 if self.processor.restore_window_state(snap):
+                    self.window_restored_from = "local"
                     logger.info("restored window state from checkpoint")
                 else:
                     logger.warning(
                         "window-state checkpoint incompatible with current "
                         "flow config; starting with empty windows"
                     )
+        if (
+            self.window_restored_from is None
+            and self.processor.window_buffers
+            and self.processor.state_mirror is not None
+        ):
+            try:
+                if self.processor.restore_window_partitions():
+                    self.window_restored_from = "partitions"
+                    logger.info(
+                        "restored window state from %d assigned partitions",
+                        len(self.processor.state_owned),
+                    )
+            except Exception:  # noqa: BLE001 — empty windows beat a dead init
+                logger.exception(
+                    "window partition handoff failed; starting empty"
+                )
+        self._drain_state_events()
 
         # sink routing: dataset -> output names; default: each conf output
         # name routes its same-named dataset (S500 contract)
@@ -315,6 +338,20 @@ class StreamingHost:
         from ..pilot.controller import PilotController
 
         self.pilot = PilotController.from_conf(dict_, host=self)
+
+    def _drain_state_events(self) -> None:
+        """Flight-record the DX53x events the state loaders queued
+        (DX530 active-side fallback, DX531 both-sides-bad -> empty):
+        typed events beside conformance drift, so a corrupted snapshot
+        handoff is visible in `obs trace` output and the recorder."""
+        events, self.processor.state_events = (
+            self.processor.state_events, []
+        )
+        for ev in events:
+            try:
+                self.telemetry.track_event("state/fallback", dict(ev))
+            except Exception:  # noqa: BLE001 — telemetry never fails state
+                logger.exception("state event emit failed")
 
     # -- pilot actuation surface ------------------------------------------
     def live_depth(self) -> int:
@@ -647,6 +684,9 @@ class StreamingHost:
             self.batches_processed + 1,
             " ".join(f"{k}={v:.1f}" for k, v in sorted(metrics.items())),
         )
+        # DX53x state events (load fallback / both-sides-bad) land in
+        # the flight recorder like conformance drift — typed, greppable
+        self._drain_state_events()
         if self.checkpointer and (
             t0 - self._last_checkpoint >= self.checkpoint_interval_s
         ):
@@ -657,9 +697,14 @@ class StreamingHost:
                     # rings that already contain them (at-least-once
                     # duplicates); the reverse order would resume PAST events
                     # the restored rings never saw — a hole in window history
-                    self.window_checkpointer.save(
-                        self.processor.snapshot_window_state()
-                    )
+                    snap = self.processor.snapshot_window_state()
+                    self.window_checkpointer.save(snap)
+                    if self.processor.state_mirror is not None:
+                        # ship the owned window partitions (A/B + pointer
+                        # per partition) so a rescale successor can pull
+                        # exactly its assigned range — fail-closed: a
+                        # dead store fails the batch, which requeues
+                        self.processor.push_window_partitions(snap)
                 self.checkpointer.checkpoint_batch(consumed)
             self._last_checkpoint = t0
             self.health.record_checkpoint()

@@ -681,6 +681,64 @@ class FlowProcessor:
         # already donated would read a deleted buffer)
         self._device_state_lock = threading.Lock()
 
+        # partitioned state (datax.job.process.state.*): every stateful
+        # surface — accumulator tables AND window-ring snapshots —
+        # hashes onto `partitions` key-range partitions
+        # (runtime/statepartition.py); this replica owns the contiguous
+        # range `replicaindex`/`replicacount` assigns it, persists only
+        # those partitions, and (with `snapshoturl` set) ships them
+        # through the shared objstore:// store so a successor replica
+        # pulls exactly its assigned partitions on a rescale handoff.
+        # `partitionkey` names the key column (per-table override:
+        # statetable.<name>.partitionkey); `filteringest` drops rows of
+        # un-owned partitions at encode time (key-routed ingest — the
+        # Kafka key-partitioning contract restated for this engine).
+        from .statepartition import (
+            DEFAULT_STATE_PARTITIONS,
+            ObjstoreSnapshotStore,
+            owned_partitions,
+        )
+
+        state_conf = process_conf.get_sub_dictionary("state.")
+        sp = state_conf.get_int_option("partitions")
+        if sp is not None and sp < 1:
+            raise EngineException(
+                f"process.state.partitions must be >= 1, got {sp}"
+            )
+        self.state_partitions = sp or DEFAULT_STATE_PARTITIONS
+        self.state_replica_count = max(
+            1, state_conf.get_int_option("replicacount") or 1
+        )
+        self.state_replica_index = state_conf.get_int_option("replicaindex") or 1
+        if not 1 <= self.state_replica_index <= self.state_replica_count:
+            raise EngineException(
+                f"process.state.replicaindex must be in "
+                f"1..{self.state_replica_count}, got {self.state_replica_index}"
+            )
+        self.state_owned = owned_partitions(
+            self.state_replica_index, self.state_replica_count,
+            self.state_partitions,
+        )
+        self.state_partition_key = state_conf.get("partitionkey")
+        self.state_filter_ingest = (
+            (state_conf.get_or_else("filteringest", "false") or "")
+            .lower() == "true"
+        ) and self.state_replica_count > 1
+        self._filter_warned: set = set()
+        self.state_mirror = None
+        snapshot_url = state_conf.get("snapshoturl")
+        if snapshot_url:
+            try:
+                self.state_mirror = ObjstoreSnapshotStore(snapshot_url)
+            except ValueError as e:
+                raise EngineException(
+                    f"process.state.snapshoturl invalid: {e}"
+                ) from None
+        # State_* metric deltas drained at collect + DX53x events the
+        # host flight-records (shared with every StateTable)
+        self.state_stats: Dict[str, float] = {}
+        self.state_events: List[dict] = []
+
         # AOT compile + persistent compilation cache (the zero-cold-
         # start path, datax.job.process.compile.*): `manifest` carries
         # the compile manifest config generation embedded (inline JSON,
@@ -850,15 +908,27 @@ class FlowProcessor:
                 )
             self.windows[wname] = (table, sub.get_duration("windowduration"))
 
-        # state tables
+        # state tables — partitioned: each replica persists only its
+        # owned key-range partitions, mirrored through objstore:// when
+        # process.state.snapshoturl is set (the rescale-handoff path)
         self.state_tables: Dict[str, StateTable] = {}
         for sname, sub in dict_.group_by_sub_namespace(
             SettingNamespace.JobProcessPrefix + "statetable."
         ).items():
             schema = parse_state_table_schema(sub.get_string("schema"))
             location = sub.get_or_else("location", f"/tmp/dxtpu-state/{sname}")
+            key = sub.get("partitionkey") or (
+                self.state_partition_key
+                if self.state_partition_key in schema.types else None
+            )
             self.state_tables[sname] = StateTable(
-                sname, schema, self.batch_capacity * 4, location
+                sname, schema, self.batch_capacity * 4, location,
+                partitions=self.state_partitions,
+                owned=self.state_owned,
+                partition_key=key,
+                mirror=self.state_mirror,
+                stats=self.state_stats,
+                events=self.state_events,
             )
 
         # jit re-traces observed since the last collect (UDF-refresh
@@ -1081,9 +1151,17 @@ class FlowProcessor:
             self.window_buffers[table] = make_buffers(
                 self.target_schemas[table], target_caps[table], slots
             )
+        # state load is the handoff-critical path of a successor
+        # replica (pull owned partitions from the mirror): time it once
+        # so State_Handoff_Ms reports what the rescale actually cost
+        t0 = time.time()
         self.state_data: Dict[str, TableData] = {
             sname: st.load(self.dictionary) for sname, st in self.state_tables.items()
         }
+        if self.state_tables:
+            self.state_stats.setdefault(
+                "Handoff_Ms", (time.time() - t0) * 1000.0
+            )
         self._slot_counter = 0
         self._base_ms: Optional[int] = None
         # host-side ingest counters (e.g. rows dropped for garbage
@@ -1112,13 +1190,21 @@ class FlowProcessor:
         via the StreamingContext checkpoint, StreamingHost.scala:83-89)."""
         # under the device-state lock: the checkpoint may run on the
         # background landing thread while the dispatch thread is about
-        # to donate these very ring buffers into the next step
+        # to donate these very ring buffers into the next step. The
+        # copies must be REAL copies — ``np.asarray`` of a CPU jax
+        # array is a zero-copy VIEW of the device buffer, and a view
+        # escaping this lock dangles the moment the next dispatch
+        # donates the ring (reads after that are use-after-free: heap
+        # corruption, not just stale data)
         with self._device_state_lock:
             rings = {}
             for table, buf in self.window_buffers.items():
                 rings[table] = {
-                    "cols": {c: np.asarray(a) for c, a in buf.cols.items()},
-                    "valid": np.asarray(buf.valid),
+                    "cols": {
+                        c: np.array(a, copy=True)
+                        for c, a in buf.cols.items()
+                    },
+                    "valid": np.array(buf.valid, copy=True),
                 }
             return {
                 "rings": rings,
@@ -1152,9 +1238,15 @@ class FlowProcessor:
                 for c in buf.cols
             ):
                 return False
+            # copy=True is load-bearing: ``jnp.asarray`` ZERO-COPIES a
+            # 64-byte-aligned numpy buffer on the CPU backend, and the
+            # rings are the step's DONATED argument — donating an
+            # aliased buffer has XLA free memory numpy owns (heap
+            # corruption, flaky segfaults under the pipelined loop)
             restored[table] = WindowBuffers(
-                {c: jnp.asarray(a) for c, a in saved["cols"].items()},
-                jnp.asarray(saved["valid"]),
+                {c: jnp.array(a, copy=True)
+                 for c, a in saved["cols"].items()},
+                jnp.array(saved["valid"], copy=True),
             )
         if self.mesh is not None:
             from ..dist.mesh import ring_sharding
@@ -1172,6 +1264,129 @@ class FlowProcessor:
         base = snap.get("base_ms")
         self._base_ms = int(base) if base is not None else None
         return True
+
+    # -- partitioned window state (the rescale-handoff path) --------------
+    WINDOW_STORE_NAME = "__window__"
+
+    def _window_key_cols(self) -> Dict[str, Tuple[str, str]]:
+        """Ring table -> (partition-key column, kind): the conf'd
+        ``state.partitionkey`` when the table carries it, else the
+        first non-timestamp column (rows of tables with no usable key
+        land in partition 0 — statepartition.split_window_snapshot)."""
+        out: Dict[str, Tuple[str, str]] = {}
+        for table in self.ring_slots:
+            types = self.target_schemas[table].types
+            key = None
+            if self.state_partition_key and \
+                    self.state_partition_key in types:
+                key = self.state_partition_key
+            else:
+                key = next(
+                    (c for c in types if c != self.timestamp_column), None
+                )
+            if key is not None:
+                out[table] = (key, types[key])
+        return out
+
+    def push_window_partitions(self, snap: Dict[str, object]) -> int:
+        """Ship this replica's OWNED window partitions to the objstore
+        mirror as per-partition A/B snapshots + pointer (the same
+        layout the state tables use). Called on the checkpoint cadence
+        after commit; fail-closed like every state push."""
+        if self.state_mirror is None or not snap.get("rings"):
+            return 0
+        from .statepartition import other_side, snapshot_to_bytes
+        from .statepartition import split_window_snapshot
+
+        parts = split_window_snapshot(
+            snap, self.state_partitions, self._window_key_cols(),
+            dictionary=self.dictionary, only=self.state_owned,
+        )
+        for p, part_snap in parts.items():
+            prefix = f"{self.WINDOW_STORE_NAME}/p{p:02d}"
+            side = other_side(self.state_mirror.get_pointer(prefix) or "B")
+            self.state_mirror.put_files(
+                prefix, side, {"window.npz": snapshot_to_bytes(part_snap)}
+            )
+            self.state_mirror.put_pointer(prefix, side)
+        self.state_stats["Snapshot_Push_Count"] = (
+            self.state_stats.get("Snapshot_Push_Count", 0) + len(parts)
+        )
+        return len(parts)
+
+    def pull_window_partitions(self) -> List[Dict]:
+        """Fetch this replica's assigned window partitions from the
+        mirror — possibly written by SEVERAL predecessors. A corrupt
+        active side falls back to the standby (DX530 +
+        ``State_LoadFallback_Count``), both-bad loads nothing for that
+        partition (DX531) and the un-acked window replay re-aggregates."""
+        if self.state_mirror is None:
+            return []
+        from .statepartition import other_side, snapshot_from_bytes
+
+        out: List[Dict] = []
+        pulled = 0
+        for p in self.state_owned:
+            prefix = f"{self.WINDOW_STORE_NAME}/p{p:02d}"
+            pointer = self.state_mirror.get_pointer(prefix)
+            if pointer is None:
+                continue
+            snap = None
+            for attempt, side in enumerate((pointer, other_side(pointer))):
+                data = self.state_mirror.get_file(prefix, side, "window.npz")
+                if data is None:
+                    continue
+                try:
+                    snap = snapshot_from_bytes(data)
+                    break
+                except Exception as e:  # noqa: BLE001 — corrupt snapshot
+                    self.state_stats["LoadFallback_Count"] = (
+                        self.state_stats.get("LoadFallback_Count", 0) + 1
+                    )
+                    code = "DX530" if attempt == 0 else "DX531"
+                    self.state_events.append({
+                        "code": code, "table": self.WINDOW_STORE_NAME,
+                        "partition": p, "side": side,
+                        "message": (
+                            f"window partition {p} side {side} "
+                            f"unreadable ({e})"
+                        ),
+                        "ts": time.time(),
+                    })
+            if snap is not None:
+                out.append(snap)
+                pulled += 1
+        if pulled:
+            self.state_stats["Snapshot_Pull_Count"] = (
+                self.state_stats.get("Snapshot_Pull_Count", 0) + pulled
+            )
+        return out
+
+    def restore_window_partitions(self) -> bool:
+        """The successor half of a window handoff: pull the assigned
+        partitions, merge them (re-packed per slot, timestamps rebased,
+        string ids remapped into the LIVE dictionary —
+        statepartition.merge_window_snapshots) and restore. False when
+        the mirror holds nothing usable."""
+        parts = self.pull_window_partitions()
+        if not parts:
+            return False
+        from .statepartition import merge_window_snapshots
+
+        merged = merge_window_snapshots(
+            parts,
+            {t: dict(self.target_schemas[t].types) for t in self.ring_slots},
+            self.dictionary,
+            self.timestamp_column,
+        )
+        if merged is None:
+            return False
+        dropped = merged.pop("dropped_rows", 0)
+        if dropped:
+            self.state_stats["WindowRows_Dropped_Count"] = (
+                self.state_stats.get("WindowRows_Dropped_Count", 0) + dropped
+            )
+        return self.restore_window_state(merged)
 
     # -- the jitted step --------------------------------------------------
     def _jit_step(self):
@@ -1274,7 +1489,15 @@ class FlowProcessor:
             ColumnName.RawSystemPropertiesColumn,
             jnp.zeros((spec.capacity,), jnp.int32),
         )
-        return TableData(cols, b.valid)
+        valid = b.valid
+        if self.state_filter_ingest:
+            key = self.state_partition_key
+            src = cols.get(key)
+            valid = jnp.asarray(self._filter_unowned(
+                np.asarray(src) if src is not None else None,
+                np.asarray(valid), spec,
+            ))
+        return TableData(cols, valid)
 
     def encode_json_bytes(
         self,
@@ -1379,8 +1602,13 @@ class FlowProcessor:
                     )
                 else:
                     np_cols[extra] = np.zeros(cap, np.int32)
+        valid = np.asarray(valid)
+        if self.state_filter_ingest:
+            valid = self._filter_unowned(
+                np_cols.get(self.state_partition_key), valid, spec
+            )
         if packed:
-            return pack_raw(np_cols, np.asarray(valid), to_device=to_device)
+            return pack_raw(np_cols, valid, to_device=to_device)
         return TableData(
             {c: jnp.asarray(a) for c, a in np_cols.items()},
             jnp.asarray(valid),
@@ -1412,10 +1640,52 @@ class FlowProcessor:
                 cols[c] = jnp.zeros((cap,), fill_dtype.get(t, jnp.int32))
         valid = np.zeros(cap, dtype=bool)
         valid[: min(n, cap)] = True
+        if self.state_filter_ingest and n > 0:
+            key = self.state_partition_key
+            src = cols.get(key)
+            valid = self._filter_unowned(
+                np.asarray(src) if src is not None else None, valid, spec
+            )
         return TableData(cols, jnp.asarray(valid))
 
     def _empty_raw(self, spec: SourceSpec) -> TableData:
         return self.encode_columns({}, 0, source=spec.name)
+
+    def _filter_unowned(self, key_vals, valid: np.ndarray,
+                        spec: SourceSpec) -> np.ndarray:
+        """Key-routed ingest (``process.state.filteringest``): zero the
+        validity of rows whose key hashes to a partition this replica
+        does NOT own, so N replicas fed the same stream process each
+        key exactly once between them (the consumer-group contract
+        restated over key-range partitions). Dropped rows count into
+        ``State_IngestFiltered_Count``. No-op unless armed AND the
+        source's raw schema carries the conf'd partition key."""
+        key = self.state_partition_key
+        if not key or key not in spec.raw_schema.types:
+            if spec.name not in self._filter_warned:
+                self._filter_warned.add(spec.name)
+                logger.warning(
+                    "state.filteringest armed but source %r has no "
+                    "partition-key column %r; NOT filtering",
+                    spec.name, key,
+                )
+            return valid
+        if key_vals is None:
+            return valid
+        from .statepartition import partition_ids
+
+        pids = partition_ids(
+            np.asarray(key_vals), self.state_partitions,
+            spec.raw_schema.types[key], dictionary=self.dictionary,
+        )
+        mask = np.isin(pids, np.asarray(self.state_owned, dtype=np.int64))
+        valid = np.asarray(valid)
+        dropped = int(np.count_nonzero(valid & ~mask))
+        if dropped:
+            self.state_stats["IngestFiltered_Count"] = (
+                self.state_stats.get("IngestFiltered_Count", 0) + dropped
+            )
+        return valid & mask
 
     def _debug_guard(self):
         """Context armed by the ``process.debug`` conf block around the
@@ -2401,6 +2671,18 @@ class PendingBatch:
                 valid_rows / self._transferred_rows
                 if self._transferred_rows else 1.0
             )
+        # partitioned-state accounting: the partition geometry this
+        # replica runs (gauges) plus the deltas since the last collect
+        # — load fallbacks (DX530/531), snapshot pushes/pulls through
+        # the objstore mirror, the successor handoff cost, and rows the
+        # key-routed ingest filter dropped as un-owned
+        if proc.state_tables or proc.state_replica_count > 1:
+            metrics["State_Partition_Count"] = float(proc.state_partitions)
+            metrics["State_Partition_Owned"] = float(len(proc.state_owned))
+        if proc.state_stats:
+            for k, v in proc.state_stats.items():
+                metrics[f"State_{k}"] = float(v)
+            proc.state_stats.clear()
         # bytes the blocking counts-only sync moved — the whole
         # synchronous wire cost of the batch tail (everything else
         # streams in the background)

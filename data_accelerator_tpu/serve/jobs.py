@@ -368,6 +368,11 @@ class LocalJobClient(TpuJobClient):
                 "datax.job.process.telemetry.parenttrace="
                 f"{job['parentTrace']}"
             )
+        for k, v in (job.get("confOverrides") or {}).items():
+            # per-replica conf overrides (same key=value contract): the
+            # rescale path passes each replica its state partition
+            # assignment (process.state.replicaindex/replicacount)
+            cmd.append(f"{k}={v}")
         env = {**os.environ, **self.env}
         stdout = subprocess.DEVNULL
         if self.log_dir:
@@ -565,6 +570,9 @@ class K8sJobClient(TpuJobClient):
                 "datax.job.process.telemetry.parenttrace="
                 f"{job['parentTrace']}"
             )
+        if container.get("args"):
+            for k, v in (job.get("confOverrides") or {}).items():
+                container["args"].append(f"{k}={v}")
         return manifest
 
     def _jobs_url(self, name: Optional[str] = None) -> str:
@@ -774,6 +782,52 @@ class JobOperation:
         out.sort(key=lambda r: r.get("replicaIndex") or 0)
         return out
 
+    def _state_partition_plan(self, base: dict, replicas: int) -> dict:
+        """Compute + persist the state-partition map of the new replica
+        set: the admitted rescale plan now CARRIES the partition
+        assignment (ROADMAP item 4). The map lands on the base job
+        record (``statePartitionMap``) and its geometry exports as the
+        ``State_Partition_*`` series under DATAX-Fleet; each spawned
+        replica receives its contiguous range via conf overrides
+        (``process.state.replicaindex``/``replicacount``/``partitions``)
+        and pulls exactly those partitions from the snapshot store at
+        init — the handoff, not a state loss."""
+        from ..runtime.statepartition import (
+            DEFAULT_STATE_PARTITIONS,
+            partition_map,
+            reassigned_partitions,
+        )
+
+        partitions = int(
+            base.get("statePartitions") or DEFAULT_STATE_PARTITIONS
+        )
+        old_map = base.get("statePartitionMap") or {}
+        new_map = partition_map(replicas, partitions)
+        moved = reassigned_partitions(old_map, new_map) if old_map else []
+        base["statePartitions"] = partitions
+        base["statePartitionMap"] = {
+            str(i): parts for i, parts in new_map.items()
+        }
+        base["statePartitionsReassigned"] = moved
+        if self.admission_gate is not None:
+            try:
+                self.admission_gate.metrics.send_batch_metrics({
+                    "State_Partition_Count": float(partitions),
+                    "State_Partition_Reassigned_Count": float(len(moved)),
+                })
+            except Exception:  # noqa: BLE001 — metrics never gate a rescale
+                logger.exception("state partition metric export failed")
+        return new_map
+
+    @staticmethod
+    def _replica_conf_overrides(index: int, count: int,
+                                partitions: int) -> Dict[str, str]:
+        return {
+            "datax.job.process.state.replicaindex": str(index),
+            "datax.job.process.state.replicacount": str(count),
+            "datax.job.process.state.partitions": str(partitions),
+        }
+
     def rescale(self, job_name: str, replicas: int) -> List[dict]:
         """In-place replica scaling — the path a replica-count change
         used to require a stop+start for. ``replicas`` counts the base
@@ -782,13 +836,19 @@ class JobOperation:
         vetted by the fleet admission gate BEFORE any process spawns
         (``FleetAdmissionGate.admit_replicas`` — capacity codes over N
         copies of the flow's footprint); scale-DOWN stops the
-        highest-numbered replicas first. The replanner refreshes
-        placement after every change. Returns the live record set
-        (base + replicas)."""
+        highest-numbered replicas first. The admitted plan carries the
+        state-partition map (``_state_partition_plan``): every spawned
+        replica gets its contiguous partition range as conf overrides,
+        so stateful flows hand partitions off instead of losing them.
+        The replanner refreshes placement after every change. Returns
+        the live record set (base + replicas)."""
         base = self.sync_job_state(job_name)
         replicas = max(1, int(replicas))
         live = self.replica_records(job_name)
         have = 1 + len(live)
+        pmap = self._state_partition_plan(base, replicas)
+        partitions = int(base["statePartitions"])
+        self.registry.upsert(base)
         if replicas > have:
             if self.admission_gate is not None:
                 # raises FleetAdmissionError (recording the rejection
@@ -796,10 +856,13 @@ class JobOperation:
                 self.admission_gate.admit_replicas(base, replicas)
             taken = {r.get("replicaIndex") for r in live}
             idx = 2
-            for _ in range(replicas - have):
+            for i in range(replicas - have):
                 while idx in taken:
                     idx += 1
                 taken.add(idx)
+                # the i-th new replica takes position have+1+i in the
+                # final set — its contiguous partition range under pmap
+                position = have + 1 + i
                 rec = {
                     "name": f"{job_name}-r{idx}",
                     "flow": base.get("flow"),
@@ -807,6 +870,10 @@ class JobOperation:
                     "replicaOf": job_name,
                     "replicaIndex": idx,
                     "state": JobState.Idle,
+                    "statePartitionsOwned": sorted(pmap.get(position, [])),
+                    "confOverrides": self._replica_conf_overrides(
+                        position, replicas, partitions
+                    ),
                 }
                 with tracing.span(
                     "rescale/submit", job=rec["name"], of=job_name

@@ -27,8 +27,10 @@ from __future__ import annotations
 import json
 import logging
 import os
+import random
 import re
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -39,9 +41,50 @@ logger = logging.getLogger(__name__)
 
 Transport = Callable[[str, str, Optional[bytes]], Tuple[int, bytes]]
 
+# bounded exponential backoff on transient store failures: 3 attempts,
+# doubling delay with jitter. Every consumer shares the SAME retry
+# shape; what differs is the posture AFTER the retries are spent —
+# fail-open for the compile cache (compile/aotcache.py: a cold compile
+# beats a dead host), fail-closed for state snapshots
+# (runtime/statepartition.py: losing state is never better than
+# failing the batch).
+RETRY_ATTEMPTS = 3
+RETRY_BASE_S = 0.05
+RETRY_MAX_S = 1.0
+
+
+def retry_transient(fn, attempts: int = RETRY_ATTEMPTS,
+                    base_s: float = RETRY_BASE_S,
+                    max_s: float = RETRY_MAX_S,
+                    what: str = "object-store operation"):
+    """Run ``fn`` with bounded, jittered exponential backoff on
+    transient failures (transport errors and 5xx responses surface as
+    IOError/OSError from the client methods below). The LAST failure
+    re-raises — posture (open/closed) is the caller's decision."""
+    last: Optional[Exception] = None
+    for attempt in range(max(1, int(attempts))):
+        try:
+            return fn()
+        except (IOError, OSError) as e:  # includes urllib.error.URLError
+            last = e
+            if attempt + 1 >= attempts:
+                break
+            delay = min(max_s, base_s * (2 ** attempt))
+            delay *= 0.5 + random.random()  # jitter: 0.5x..1.5x
+            logger.warning(
+                "%s failed (attempt %d/%d, retrying in %.0f ms): %s",
+                what, attempt + 1, attempts, delay * 1000, e,
+            )
+            time.sleep(delay)
+    raise last  # type: ignore[misc]
+
 
 class ObjectStoreClient:
-    """Minimal object-store client over the REST subset above."""
+    """Minimal object-store client over the REST subset above.
+
+    Transient failures — connection errors and 5xx responses — retry
+    with bounded jittered backoff (``retries`` attempts); definitive
+    answers (2xx, 404, 4xx) never retry."""
 
     def __init__(
         self,
@@ -49,6 +92,7 @@ class ObjectStoreClient:
         bucket: str = "dxtpu",
         token: Optional[str] = None,
         http: Optional[Transport] = None,
+        retries: int = RETRY_ATTEMPTS,
     ):
         self.endpoint = endpoint.rstrip("/")
         parsed = urllib.parse.urlparse(self.endpoint)
@@ -65,7 +109,22 @@ class ObjectStoreClient:
             )
         self.bucket = bucket
         self.token = token
+        self.retries = max(1, int(retries))
         self._http = http or self._urllib_http
+
+    def _request(self, method: str, url: str, body: Optional[bytes],
+                 what: str) -> Tuple[int, bytes]:
+        """One logical request: transport errors and 5xx answers are
+        transient (the server may be restarting, the LB draining) and
+        retry with jittered backoff; anything else is definitive."""
+
+        def once():
+            status, resp = self._http(method, url, body)
+            if status >= 500:
+                raise IOError(f"{what} failed ({status})")
+            return status, resp
+
+        return retry_transient(once, attempts=self.retries, what=what)
 
     # -- transport -------------------------------------------------------
     def _urllib_http(self, method: str, url: str, body: Optional[bytes]):
@@ -88,12 +147,16 @@ class ObjectStoreClient:
 
     # -- operations ------------------------------------------------------
     def put(self, key: str, content: bytes) -> None:
-        status, body = self._http("PUT", self._url(key), content)
+        status, body = self._request(
+            "PUT", self._url(key), content, f"object put {key!r}"
+        )
         if status not in (200, 201, 204):
             raise IOError(f"object put {key!r} failed ({status})")
 
     def get(self, key: str) -> Optional[bytes]:
-        status, body = self._http("GET", self._url(key), None)
+        status, body = self._request(
+            "GET", self._url(key), None, f"object get {key!r}"
+        )
         if status == 404:
             return None
         if status != 200:
@@ -101,7 +164,9 @@ class ObjectStoreClient:
         return body
 
     def delete(self, key: str) -> bool:
-        status, _ = self._http("DELETE", self._url(key), None)
+        status, _ = self._request(
+            "DELETE", self._url(key), None, f"object delete {key!r}"
+        )
         if status in (200, 202, 204):
             return True
         if status == 404:
@@ -110,7 +175,9 @@ class ObjectStoreClient:
 
     def list(self, prefix: str = "") -> List[str]:
         q = "prefix=" + urllib.parse.quote(prefix) if prefix else ""
-        status, body = self._http("GET", self._url(query=q), None)
+        status, body = self._request(
+            "GET", self._url(query=q), None, f"object list {prefix!r}"
+        )
         if status != 200:
             raise IOError(f"object list {prefix!r} failed ({status})")
         return json.loads(body.decode() or "[]")

@@ -302,11 +302,13 @@ def _assert_exactly_once(ctx, n: int) -> None:
     )
 
 
-def _assert_pilot_reacted(ctx, action: str) -> None:
+def _assert_pilot_reacted(ctx, action: str, host=None) -> None:
     """Pilot-on acceptance: the expected actuation fired, the
     Pilot_Actuations_Count series is > 0, and the actuation is visible
-    as a ``pilot/decide`` span in the flight recorder."""
-    host = ctx["host"]
+    as a ``pilot/decide`` span in the flight recorder. ``host``
+    overrides ``ctx['host']`` for drills that rotate hosts (the
+    rescale handoff asserts against the PREDECESSOR's pilot)."""
+    host = host if host is not None else ctx["host"]
     pilot = host.pilot
     assert pilot is not None
     applied = [
@@ -603,14 +605,353 @@ def chaos_malformed_flood(pilot: bool = False, depth: int = 2) -> Scenario:
     return sc
 
 
+# ---------------------------------------------------------------------------
+# Rescale-with-state chaos drill (the elastic stateful rescale proof):
+# a stateful TIMEWINDOW + accumulator flow is rescaled MID-WINDOW —
+# up (1 -> 2 replicas) then down (2 -> 1) — with a snapshot corruption
+# injected between predecessor stop and successor load. Every event
+# must land exactly once ACROSS the whole replica lineage, partitioned
+# state must follow the replicas through the objstore mirror, and the
+# corrupted partition must recover via the standby side (DX530).
+# ---------------------------------------------------------------------------
+_STATE_SCHEMA = json.dumps({"type": "struct", "fields": [
+    {"name": "k", "type": "long", "nullable": False, "metadata": {}},
+    {"name": "v", "type": "double", "nullable": False, "metadata": {}},
+    {"name": "seq", "type": "long", "nullable": False, "metadata": {}},
+]})
+
+_STATE_TRANSFORM = (
+    "--DataXQuery--\n"
+    "merged = SELECT k, v FROM DataXProcessedInput "
+    "UNION ALL SELECT k, v FROM seen\n"
+    "--DataXQuery--\n"
+    "seen = SELECT k, MAX(v) AS v FROM merged GROUP BY k\n"
+    "--DataXQuery--\n"
+    "Out = SELECT k, v, seq FROM DataXProcessedInput\n"
+    "--DataXQuery--\n"
+    "Win = SELECT k, COUNT(*) AS c "
+    "FROM DataXProcessedInput_30seconds GROUP BY k\n"
+)
+
+_STATE_KEYS = 8
+_STATE_PARTS = 8
+
+
+def _state_events(lo: int, hi: int) -> list:
+    return [
+        {"k": i % _STATE_KEYS, "v": float(i), "seq": i}
+        for i in range(lo, hi)
+    ]
+
+
+def _build_stateful_host(ctx, name: str, pilot: bool, depth: int,
+                         replica_index: int = 1, replica_count: int = 1,
+                         gen: int = 0, pilot_conf: Optional[dict] = None,
+                         src=None):
+    """One socket-fed stateful host: TIMEWINDOW ring + `seen` MAX
+    accumulator, state hashed onto ``_STATE_PARTS`` key-range
+    partitions mirrored through the scenario's live object store.
+    ``gen`` isolates checkpoint/state dirs per host INSTANCE, so a
+    successor's only route to predecessor state is the partition
+    handoff through the mirror — exactly the cross-host shape."""
+    import os
+
+    from ..core.config import SettingDictionary
+    from ..pilot.chaos import RecordingSink
+    from ..runtime.host import StreamingHost
+    from ..runtime.sources import SocketSource
+
+    workdir = ctx["workdir"]
+    tpath = os.path.join(workdir, "state.transform")
+    if not os.path.exists(tpath):
+        with open(tpath, "w", encoding="utf-8") as f:
+            f.write(_STATE_TRANSFORM)
+    hostdir = os.path.join(workdir, f"g{gen}-r{replica_index}")
+    conf = {
+        "datax.job.name": name,
+        "datax.job.input.default.blobschemafile": _STATE_SCHEMA,
+        "datax.job.input.default.eventhub.maxrate": "4",
+        "datax.job.input.default.eventhub.checkpointdir": os.path.join(
+            hostdir, "ckpt"
+        ),
+        "datax.job.input.default.eventhub.checkpointinterval": "0 second",
+        "datax.job.input.default.streaming.intervalinseconds": "1",
+        "datax.job.process.timestampcolumn": "ts",
+        "datax.job.process.watermark": "0 second",
+        "datax.job.process.transform": tpath,
+        "datax.job.process.batchcapacity": "8",
+        "datax.job.process.pipeline.depth": str(depth),
+        "datax.job.process.timewindow.DataXProcessedInput_30seconds"
+        ".windowduration": "30 seconds",
+        "datax.job.process.statetable.seen.schema": "k long, v double",
+        "datax.job.process.statetable.seen.location": os.path.join(
+            hostdir, "state", "seen"
+        ),
+        "datax.job.process.state.partitions": str(_STATE_PARTS),
+        "datax.job.process.state.partitionkey": "k",
+        "datax.job.process.state.replicaindex": str(replica_index),
+        "datax.job.process.state.replicacount": str(replica_count),
+        "datax.job.process.state.snapshoturl": ctx["store_url"],
+        "datax.job.process.state.filteringest": "true",
+        "datax.job.process.telemetry.tracefile": os.path.join(
+            workdir, "trace.jsonl"
+        ),
+        "datax.job.output.Out.console.maxrows": "0",
+        "datax.job.output.Win.console.maxrows": "0",
+    }
+    if pilot:
+        conf.update({
+            "datax.job.process.pilot.windowseconds": "0.02",
+            "datax.job.process.pilot.cooldownseconds": "0.02",
+            "datax.job.process.observability.stallewmams": "200",
+        })
+        for k, v in (pilot_conf or {}).items():
+            conf[f"datax.job.process.pilot.{k}"] = str(v)
+    else:
+        conf["datax.job.process.pilot.enabled"] = "false"
+    if src is None:
+        src = SocketSource(port=0)
+    host = StreamingHost(SettingDictionary(conf), source=src)
+    sink = RecordingSink()
+    host.dispatcher.operators["Out"].sinks = [sink]
+    host.dispatcher.operators["Win"].sinks = [RecordingSink()]
+    ctx["host"], ctx["src"], ctx["sink"] = host, src, sink
+    ctx.setdefault("sinks", []).append(sink)
+    ctx["tracefile"] = conf["datax.job.process.telemetry.tracefile"]
+    return host
+
+
+def _drain_remaining_payload(src) -> bytes:
+    """Everything a stopped predecessor's source still holds —
+    requeued un-acked batches plus never-polled buffer — as one raw
+    payload (the events a key-routed rebalance re-delivers)."""
+    src.requeue_unacked()
+    chunks = []
+    while True:
+        blob, n, _offsets = src.poll_raw(1000)
+        if n == 0:
+            break
+        chunks.append(blob)
+    src.close()
+    return b"".join(chunks)
+
+
+def _drain_group(ctx, hosts, expect_total: int, chunk: int = 2,
+                 timeout_s: float = 60.0) -> None:
+    """Run a replica GROUP in round-robin chunks until every expected
+    event has landed across the lineage's sinks."""
+    deadline = time.time() + timeout_s
+    while len(_delivered(ctx)) < expect_total:
+        for h in hosts:
+            h.run_pipelined(max_batches=h.batches_processed + chunk)
+        if time.time() > deadline:
+            raise AssertionError(
+                f"group drain timed out: {len(_delivered(ctx))}/"
+                f"{expect_total} delivered"
+            )
+
+
+def _loaded_state_map(host) -> dict:
+    """The `seen` accumulator a replica PERSISTED (its owned
+    partitions), reloaded from disk: {k: max v}."""
+    import numpy as np
+
+    t = host.processor.state_tables["seen"].load(host.processor.dictionary)
+    return {
+        int(k): float(v)
+        for k, v, ok in zip(
+            np.asarray(t.cols["k"]), np.asarray(t.cols["v"]),
+            np.asarray(t.valid),
+        ) if ok
+    }
+
+
+def chaos_rescale_with_state(pilot: bool = False, depth: int = 2) -> Scenario:
+    """Elastic stateful rescale, chaos-proven: a stateful flow
+    (TIMEWINDOW ring + `seen` accumulator on 8 key-range partitions)
+    is rescaled mid-window — up to two replicas, later back down to
+    one — with every partition's ACTIVE state snapshot corrupted in
+    the store between predecessor stop and successor load. Successors
+    pull only their assigned partitions (windows merged, accumulators
+    reloaded, corruption recovered via the standby side + un-acked
+    replay), the key-routed ingest filter splits the remaining stream
+    exactly once across the new replica group, and the whole lineage
+    delivers every event exactly once. Pilot-on: the predecessor's
+    sustained saturation drives a ``rescale-up`` actuation through the
+    vetted ScaleActuator path before the handoff."""
+    sc = Scenario(f"ChaosRescaleState{'Pilot' if pilot else ''}")
+    n_pre = 24    # events fed to the predecessor
+    n_tail = 8    # events fed after the scale-down
+    expected_final = {k: float(24 + k) for k in range(_STATE_KEYS)}
+
+    @sc.step
+    def start_store(ctx):
+        from .objectstore import ObjectStoreServer
+
+        store = ObjectStoreServer(port=0).start()  # in-memory
+        ctx["store"] = store
+        scn = f"rescale-{'p' if pilot else 'b'}-d{depth}"
+        ctx["store_url"] = (
+            f"objstore://127.0.0.1:{store.port}/chaos/{scn}"
+        )
+
+    @sc.step
+    def build_predecessor(ctx):
+        _build_stateful_host(
+            ctx, "RescaleStateP" if pilot else "RescaleStateB", pilot,
+            depth, gen=0,
+            pilot_conf={"maxdepth": depth, "saturationhigh": "0.5"},
+        )
+
+    @sc.step
+    def feed_events(ctx):
+        from ..pilot.chaos import feed_socket
+
+        feed_socket(ctx["src"], _chaos_payload(_state_events(0, n_pre)),
+                    expect_events=n_pre)
+
+    @sc.step
+    def run_until_mid_window(ctx):
+        host = ctx["host"]
+        collected = ctx.setdefault("applied_decisions", [])
+        scaler = None
+        if pilot and host.pilot is not None:
+            from ..pilot.chaos import RecordingRescaler
+            from ..pilot.controller import ScaleActuator
+
+            scaler = ctx["scaler"] = RecordingRescaler()
+            act = ScaleActuator(scaler, "RescaleState", max_replicas=4)
+            for kind in act.kinds:
+                host.pilot.actuators[kind] = act
+            orig_evaluate = host.pilot.evaluate
+
+            def evaluate(*a, **k):
+                ds = orig_evaluate(*a, **k)
+                collected.extend(ds)
+                return ds
+
+            host.pilot.evaluate = evaluate
+        # a few batches into the 30 s window, then 'preempt' for the
+        # rescale: well under n_pre events processed — state + window
+        # rings hold committed history the successors must inherit
+        host.run_pipelined(max_batches=host.batches_processed + 3)
+        if pilot and host.pilot is not None and not any(
+            d.applied and d.action == "rescale-up" for d in collected
+        ):
+            host.pilot.evaluate()
+        ctx["pilot_host"] = host
+        ctx["pre_delivered"] = len(_delivered(ctx))
+        assert 0 < ctx["pre_delivered"] < n_pre, ctx["pre_delivered"]
+        host.stop(close_sources=False)
+
+    @sc.step
+    def corrupt_partitions_mid_handoff(ctx):
+        from ..pilot.chaos import PartitionLossInjector
+
+        inj = PartitionLossInjector(
+            store_url=ctx["store_url"], table="seen", mode="truncate",
+        )
+        assert inj.corrupt(), "no active state snapshots to corrupt"
+        ctx["corrupted"] = inj.corrupted
+
+    @sc.step
+    def rescale_up_handoff(ctx):
+        payload = _drain_remaining_payload(ctx["src"])
+        name = "RescaleStateP" if pilot else "RescaleStateB"
+        b1 = _build_stateful_host(ctx, name, pilot=False, depth=depth,
+                                  replica_index=1, replica_count=2, gen=1)
+        src1 = ctx["src"]
+        b2 = _build_stateful_host(ctx, name, pilot=False, depth=depth,
+                                  replica_index=2, replica_count=2, gen=1)
+        src2 = ctx["src"]
+        ctx["successors"] = [b1, b2]
+        # the successors inherited the windows through the partition
+        # handoff (fresh local dirs — the mirror was the only route)
+        assert b1.window_restored_from == "partitions", (
+            b1.window_restored_from
+        )
+        assert b2.window_restored_from == "partitions", (
+            b2.window_restored_from
+        )
+        # the corrupted active sides were recovered via standby (DX530)
+        fallbacks = (
+            b1.processor.state_stats.get("LoadFallback_Count", 0)
+            + b2.processor.state_stats.get("LoadFallback_Count", 0)
+        )
+        assert fallbacks >= 1, "corruption never hit the loaders"
+        # BOTH successors get the FULL remaining stream; the key-routed
+        # ingest filter must split it exactly once across the group
+        from ..pilot.chaos import feed_socket
+
+        n_lines = payload.count(b"\n")
+        if n_lines:
+            feed_socket(src1, payload, expect_events=n_lines)
+            feed_socket(src2, payload, expect_events=n_lines)
+        _drain_group(ctx, [b1, b2], n_pre)
+        for h in (b1, b2):
+            h.stop()
+
+    @sc.step
+    def assert_scaled_up_exactly_once(ctx):
+        _assert_exactly_once(ctx, n_pre)
+        # partitioned accumulators followed the replicas: the merged
+        # owned-partition state of the group equals the full-stream MAX
+        merged = {}
+        for h in ctx["successors"]:
+            merged.update(_loaded_state_map(h))
+        expect = {k: float(16 + k) for k in range(_STATE_KEYS)}
+        assert merged == expect, f"state diverged: {merged} != {expect}"
+
+    @sc.step
+    def rescale_down_handoff(ctx):
+        from ..pilot.chaos import feed_socket
+
+        name = "RescaleStateP" if pilot else "RescaleStateB"
+        c = _build_stateful_host(ctx, name, pilot=False, depth=depth,
+                                 replica_index=1, replica_count=1, gen=2)
+        # scale-down merge: C's windows come from TWO predecessors'
+        # partition pushes (re-packed per slot, bases rebased)
+        assert c.window_restored_from == "partitions", (
+            c.window_restored_from
+        )
+        feed_socket(ctx["src"], _chaos_payload(
+            _state_events(n_pre, n_pre + n_tail)
+        ), expect_events=n_tail)
+        _drain_group(ctx, [c], n_pre + n_tail)
+        ctx["final_host"] = c
+        c.stop()
+
+    @sc.step
+    def assert_final_exactly_once_and_state(ctx):
+        _assert_exactly_once(ctx, n_pre + n_tail)
+        final = _loaded_state_map(ctx["final_host"])
+        assert final == expected_final, (
+            f"final state diverged: {final} != {expected_final}"
+        )
+        ctx["store"].stop()
+
+    if pilot:
+        @sc.step
+        def assert_pilot_rescaled(ctx):
+            # the PREDECESSOR ran the pilot (successors spawn unpiloted
+            # in this drill); leave it as the context host so generic
+            # pilot assertions read the right controller
+            ctx["host"] = ctx["pilot_host"]
+            _assert_pilot_reacted(ctx, "rescale-up", host=ctx["pilot_host"])
+            assert ctx["scaler"].calls and ctx["scaler"].calls[0] >= 2
+
+    return sc
+
+
 def chaos_suite(pilot: bool = False, depth: int = 2):
-    """All four chaos drills (preemption, sink outage, hot-key skew,
-    malformed flood) — the scenario-diversity matrix PILOT.md tables.
-    Each scenario needs a fresh ``ScenarioContext`` with a
-    ``workdir``."""
+    """All five chaos drills (preemption, sink outage, hot-key skew,
+    malformed flood, rescale-with-state) — the scenario-diversity
+    matrix PILOT.md tables. Each scenario needs a fresh
+    ``ScenarioContext`` with a ``workdir``."""
     return [
         chaos_preemption(pilot=pilot, depth=depth),
         chaos_sink_outage(pilot=pilot, depth=depth),
         chaos_hot_key_skew(pilot=pilot, depth=depth),
         chaos_malformed_flood(pilot=pilot, depth=depth),
+        chaos_rescale_with_state(pilot=pilot, depth=depth),
     ]

@@ -19,6 +19,7 @@ from data_accelerator_tpu.serve.scenarios import (
     chaos_hot_key_skew,
     chaos_malformed_flood,
     chaos_preemption,
+    chaos_rescale_with_state,
     chaos_sink_outage,
     chaos_suite,
 )
@@ -28,6 +29,7 @@ FAULTS = {
     "sink-outage": chaos_sink_outage,
     "hot-key-skew": chaos_hot_key_skew,
     "malformed-flood": chaos_malformed_flood,
+    "rescale-state": chaos_rescale_with_state,
 }
 
 
@@ -71,8 +73,59 @@ def test_chaos_suite_enumerates_the_full_matrix():
     names = [sc.name for sc in chaos_suite(pilot=False)]
     assert names == [
         "ChaosPreemption", "ChaosSinkOutage", "ChaosHotKeySkew",
-        "ChaosMalformedFlood",
+        "ChaosMalformedFlood", "ChaosRescaleState",
     ]
     assert [sc.name for sc in chaos_suite(pilot=True)] == [
         n + "Pilot" for n in names
     ]
+
+
+# ---------------------------------------------------------------------------
+# Rescale-with-state depth matrix (the elastic stateful rescale
+# acceptance): depths 1/2/4, pilot-off and pilot-on. Depth 2 runs in
+# tier-1 via the FAULTS matrix above under a wall-clock budget; the
+# other depths spawn 4 extra hosts each and are marked slow so
+# `-m 'not slow'` stays inside the tier-1 timeout.
+# ---------------------------------------------------------------------------
+RESCALE_WALL_CLOCK_BUDGET_S = 150.0
+
+
+def test_rescale_with_state_depth2_wall_clock_budget(tmp_path):
+    """The tier-1 depth-2 drill (both pilot modes) must fit the
+    budgeted wall clock — a handoff that stops being sub-second shows
+    up here long before it blows the suite timeout."""
+    import time
+
+    off, on = tmp_path / "off", tmp_path / "on"
+    off.mkdir()
+    on.mkdir()
+    t0 = time.time()
+    _run(chaos_rescale_with_state, pilot=False, tmp_path=off)
+    _run(chaos_rescale_with_state, pilot=True, tmp_path=on)
+    elapsed = time.time() - t0
+    assert elapsed < RESCALE_WALL_CLOCK_BUDGET_S, (
+        f"rescale-with-state depth-2 drills took {elapsed:.1f}s "
+        f"(budget {RESCALE_WALL_CLOCK_BUDGET_S}s)"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("depth", [1, 4])
+@pytest.mark.parametrize("pilot", [False, True])
+def test_rescale_with_state_depth_matrix(depth, pilot, tmp_path):
+    """Full acceptance matrix: the stateful rescale delivers every
+    window exactly once at depths 1 and 4 too, pilot-off and
+    pilot-on (depth 2 is the tier-1 row above)."""
+    import logging
+
+    logging.disable(logging.ERROR)
+    try:
+        scenario = chaos_rescale_with_state(pilot=pilot, depth=depth)
+        ctx = ScenarioContext({"workdir": str(tmp_path)})
+        result = scenario.run(ctx)
+    finally:
+        logging.disable(logging.NOTSET)
+    assert result.success, (
+        f"{scenario.name} depth={depth} failed at {result.failed_step}:\n"
+        + "".join(s.error or "" for s in result.steps)
+    )

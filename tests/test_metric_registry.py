@@ -207,6 +207,29 @@ def test_background_transfer_metrics_are_registered():
     assert not MetricName.is_runtime_metric("Sync_Bogus")
 
 
+def test_state_partition_metrics_are_registered():
+    """CI satellite: every State_* series the partitioned-state layer
+    emits (runtime/statetable.py + runtime/statepartition.py drained at
+    collect; State_Partition_Reassigned_Count from JobOperation.rescale
+    under DATAX-Fleet) resolves through the registry; emission-side
+    coverage is tests/test_statepartition.py and the rescale chaos
+    drill (tests/test_chaos.py)."""
+    for m in (
+        "State_Partition_Count",
+        "State_Partition_Owned",
+        "State_Partition_Reassigned_Count",
+        "State_Handoff_Ms",
+        "State_LoadFallback_Count",
+        "State_Snapshot_Push_Count",
+        "State_Snapshot_Pull_Count",
+        "State_IngestFiltered_Count",
+        "State_WindowRows_Dropped_Count",
+    ):
+        assert MetricName.is_runtime_metric(m), m
+    assert not MetricName.is_runtime_metric("State_Bogus")
+    assert not MetricName.is_runtime_metric("State_Partition_Bogus")
+
+
 def test_default_alert_rules_validate_and_resolve_for_shipped_flows():
     """CI satellite: the default-generated alert rules are
     schema-valid, and every threshold rule's series name resolves

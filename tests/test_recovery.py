@@ -125,6 +125,111 @@ def test_write_offsets_fsyncs_file_and_directory(tmp_path, monkeypatch):
     assert ck.starting_positions() == {("default", 0): 42}
 
 
+def test_state_table_writes_survive_torn_write(tmp_path, monkeypatch):
+    """Satellite: StateTable snapshots now carry the checkpointers'
+    power-loss contract — table.npz/meta.json AND the pointer commit
+    are fsynced (file + directory) through _durable_replace, and a torn
+    active-side write (power loss mid-flush) falls back to the standby
+    commit instead of killing the host."""
+    import jax.numpy as jnp
+
+    from data_accelerator_tpu.compile.planner import TableData, ViewSchema
+    from data_accelerator_tpu.core.schema import StringDictionary
+    from data_accelerator_tpu.runtime.statetable import StateTable
+
+    synced = []
+    real_fsync = os.fsync
+
+    def spy_fsync(fd):
+        try:
+            synced.append(os.readlink(f"/proc/self/fd/{fd}"))
+        except OSError:
+            synced.append("<unknown>")
+        return real_fsync(fd)
+
+    monkeypatch.setattr(os, "fsync", spy_fsync)
+    schema = ViewSchema({"k": "long", "v": "double"})
+    d = StringDictionary()
+
+    def table(v):
+        return TableData(
+            {"k": jnp.asarray(np.array([7], np.int32)),
+             "v": jnp.asarray(np.array([v], np.float32))},
+            jnp.asarray(np.array([True])),
+        )
+
+    st = StateTable("seen", schema, 4, str(tmp_path / "st"), partitions=2)
+    st.overwrite(table(1.0), d)
+    st.persist()
+    # snapshot data, sidecar and pointer all fsynced while still .tmp
+    assert any(p.endswith("table.npz.tmp") for p in synced), synced
+    assert any(p.endswith("meta.json.tmp") for p in synced), synced
+    assert any(p.endswith("pointer.tmp") for p in synced), synced
+    st.overwrite(table(2.0), d)
+    st.persist()
+
+    # torn write: truncate the ACTIVE side's snapshot of key 7's
+    # partition, as a crash-then-power-loss would leave it
+    from data_accelerator_tpu.runtime.statepartition import (
+        LocalSnapshotStore,
+        partition_of,
+    )
+
+    p = partition_of(7, 2)
+    active = LocalSnapshotStore(str(tmp_path / "st")).get_pointer(f"p{p:02d}")
+    path = tmp_path / "st" / f"p{p:02d}" / active / "table.npz"
+    path.write_bytes(path.read_bytes()[:8])
+
+    stats = {}
+    st2 = StateTable("seen", schema, 4, str(tmp_path / "st"), partitions=2,
+                     stats=stats)
+    loaded = st2.load(StringDictionary())
+    vals = {
+        int(k): float(v) for k, v, ok in zip(
+            np.asarray(loaded.cols["k"]), np.asarray(loaded.cols["v"]),
+            np.asarray(loaded.valid),
+        ) if ok
+    }
+    assert vals == {7: 1.0}  # the standby (previous) commit, not a crash
+    assert stats["LoadFallback_Count"] >= 1
+
+
+def test_window_checkpoint_restores_previous_on_truncated_tmp(tmp_path):
+    """Satellite: a crash mid-save leaves a torn ``window.npz.tmp``
+    behind — restore must come from the previous COMPLETE checkpoint,
+    never the torn tmp file."""
+    from data_accelerator_tpu.runtime.checkpoint import (
+        WindowStateCheckpointer,
+    )
+
+    ck = WindowStateCheckpointer(str(tmp_path / "ck"))
+    snap = {
+        "rings": {"T": {
+            "cols": {"k": np.arange(8, dtype=np.int32).reshape(2, 4)},
+            "valid": np.ones((2, 4), bool),
+        }},
+        "slot_counter": 5,
+        "base_ms": 123_000,
+    }
+    ck.save(snap)
+    # a later save died mid-write: torn tmp beside the good checkpoint
+    good = open(ck.path, "rb").read()
+    with open(ck.path + ".tmp", "wb") as f:
+        f.write(good[: len(good) // 3])
+    restored = WindowStateCheckpointer(str(tmp_path / "ck")).load()
+    assert restored is not None
+    assert restored["slot_counter"] == 5
+    assert (restored["rings"]["T"]["cols"]["k"]
+            == snap["rings"]["T"]["cols"]["k"]).all()
+
+    # and a torn MAIN file falls back to the .old backup
+    ck.save({**snap, "slot_counter": 6})  # rotates the good one to .old
+    with open(ck.path, "wb") as f:
+        f.write(good[: len(good) // 3])
+    restored = WindowStateCheckpointer(str(tmp_path / "ck")).load()
+    assert restored is not None and restored["slot_counter"] == 5
+
+
 def test_backpressure_halves_rate_on_overrun(tmp_path, monkeypatch):
     _write_events(str(tmp_path / "in" / "a.json"), [{"k": 1, "v": 1.0}])
     host = StreamingHost(_conf(tmp_path, {
