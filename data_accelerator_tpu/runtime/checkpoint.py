@@ -49,6 +49,12 @@ def snapshot_arrays(snap: Dict) -> Dict:
         for c, a in ring["cols"].items():
             arrays[f"ring/{table}/col/{c}"] = a
         arrays[f"ring/{table}/valid"] = ring["valid"]
+        if ring.get("cap") is not None:
+            # compacted partition snapshots carry the original ring
+            # capacity so the merge can rebuild the full shape
+            arrays[f"ring/{table}/cap"] = np.asarray(
+                int(ring["cap"]), np.int64
+            )
     arrays["slot_counter"] = np.asarray(int(snap.get("slot_counter", 0)),
                                         np.int64)
     base = snap.get("base_ms")
@@ -75,6 +81,8 @@ def arrays_to_snapshot(z) -> Dict:
         ring = rings.setdefault(table, {"cols": {}, "valid": None})
         if kind == "valid":
             ring["valid"] = z[key]
+        elif kind == "cap":
+            ring["cap"] = int(z[key])
         else:
             ring["cols"][kind.split("/", 1)[1]] = z[key]
     base = int(z["base_ms"])

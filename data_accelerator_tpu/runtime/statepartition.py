@@ -350,37 +350,56 @@ def split_window_snapshot(
     ``key_cols``: ring table -> (key column, kind). Rows of a table
     with no usable key column all land in partition 0 (documented —
     an unkeyed window can't follow a key-range handoff any finer).
-    Partition snapshots keep the full ring shape with non-member rows
-    masked invalid; the merge re-packs rows, so the positions don't
-    need to survive."""
+    Each partition snapshot is COMPACTED to its member rows (re-packed
+    per slot, capacity truncated to the widest slot) — the merge
+    re-packs rows anyway, so the positions don't need to survive, and
+    the mirror push ships O(member rows) per partition instead of P
+    copies of the entire ring. The original ring capacity rides along
+    as ``cap`` per table so the merge can rebuild the full shape."""
     want = set(int(p) for p in only) if only is not None else None
-    out: Dict[int, Dict] = {}
-    rings = snap.get("rings", {})
-    for p in range(partitions):
-        if want is not None and p not in want:
-            continue
-        p_rings = {}
-        for table, ring in rings.items():
-            valid = np.asarray(ring["valid"])
-            kc = key_cols.get(table)
-            if kc is not None and kc[0] in ring["cols"]:
-                pids = partition_ids(
-                    np.asarray(ring["cols"][kc[0]]), partitions, kc[1],
-                    dictionary=dictionary,
-                )
-                member = valid & (pids == p)
-            else:
-                member = valid if p == 0 else np.zeros_like(valid)
-            p_rings[table] = {
-                "cols": {c: np.asarray(a) for c, a in ring["cols"].items()},
-                "valid": member,
-            }
-        out[p] = {
-            "rings": p_rings,
+    targets = [
+        p for p in range(partitions) if want is None or p in want
+    ]
+    out: Dict[int, Dict] = {
+        p: {
+            "rings": {},
             "slot_counter": snap.get("slot_counter", 0),
             "base_ms": snap.get("base_ms"),
             "dictionary": snap.get("dictionary"),
         }
+        for p in targets
+    }
+    for table, ring in snap.get("rings", {}).items():
+        valid = np.asarray(ring["valid"])
+        cols = {c: np.asarray(a) for c, a in ring["cols"].items()}
+        k_slots, cap = valid.shape
+        kc = key_cols.get(table)
+        pids = None
+        if kc is not None and kc[0] in cols:
+            pids = partition_ids(
+                cols[kc[0]], partitions, kc[1], dictionary=dictionary,
+            )
+        for p in targets:
+            if pids is not None:
+                member = valid & (pids == p)
+            else:
+                member = valid if p == 0 else np.zeros_like(valid)
+            new_cap = int(member.sum(axis=1).max()) if k_slots else 0
+            p_cols = {
+                c: np.zeros((k_slots, new_cap), dtype=a.dtype)
+                for c, a in cols.items()
+            }
+            p_valid = np.zeros((k_slots, new_cap), dtype=bool)
+            for k in range(k_slots):
+                idx = np.nonzero(member[k])[0]
+                n = int(idx.size)
+                if n:
+                    for c, a in cols.items():
+                        p_cols[c][k, :n] = a[k][idx]
+                    p_valid[k, :n] = True
+            out[p]["rings"][table] = {
+                "cols": p_cols, "valid": p_valid, "cap": int(cap),
+            }
     return out
 
 
@@ -410,16 +429,26 @@ def merge_window_snapshots(
     out_rings: Dict[str, Dict] = {}
     fill: Dict[str, np.ndarray] = {}
     for table, ring in first.items():
+        # partition snapshots are compacted to their member rows
+        # (split_window_snapshot); the FULL ring shape is rebuilt from
+        # the ``cap`` each carries (whole, uncompacted snapshots fall
+        # back to their own width)
+        same = [
+            p["rings"][table] for p in parts if table in p.get("rings", {})
+        ]
+        k_slots = max(np.asarray(r["valid"]).shape[0] for r in same)
+        cap = max(
+            int(r.get("cap", np.asarray(r["valid"]).shape[1]))
+            for r in same
+        )
         out_rings[table] = {
             "cols": {
-                c: np.zeros_like(np.asarray(a))
+                c: np.zeros((k_slots, cap), dtype=np.asarray(a).dtype)
                 for c, a in ring["cols"].items()
             },
-            "valid": np.zeros_like(np.asarray(ring["valid"])),
+            "valid": np.zeros((k_slots, cap), dtype=bool),
         }
-        fill[table] = np.zeros(
-            np.asarray(ring["valid"]).shape[0], dtype=np.int64
-        )
+        fill[table] = np.zeros(k_slots, dtype=np.int64)
     dropped = 0
     for part in parts:
         delta = 0
@@ -437,7 +466,9 @@ def merge_window_snapshots(
             types = schema_types.get(table, {})
             dst = out_rings[table]
             valid = np.asarray(ring["valid"])
-            k_slots, cap = valid.shape
+            k_slots = valid.shape[0]
+            cap = dst["valid"].shape[1]  # room in the REBUILT ring,
+            # not the source part's compacted width
             for k in range(min(k_slots, fill[table].shape[0])):
                 idx = np.nonzero(valid[k])[0]
                 if idx.size == 0:

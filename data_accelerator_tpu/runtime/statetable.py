@@ -190,6 +190,14 @@ class StateTable:
             for attempt, side in enumerate((pointer, other_side(pointer))):
                 try:
                     part = self._read_side(p, side)
+                    # an ABSENT active side (returned None without
+                    # raising) is a fresh partition and loads EMPTY —
+                    # never the standby: after a crash between
+                    # overwrite() (standby written, in-memory flip)
+                    # and persist() (pointer never committed) the
+                    # standby holds the UNCOMMITTED batch, and loading
+                    # it double-counts the replayed un-acked window
+                    break
                 except Exception as e:  # noqa: BLE001 — corrupt snapshot
                     self.stats["LoadFallback_Count"] = (
                         self.stats.get("LoadFallback_Count", 0) + 1
@@ -209,8 +217,6 @@ class StateTable:
                         f"window replay re-aggregates",
                     )
                     part = None
-                if part is not None or attempt > 0:
-                    break
             if part is None:
                 continue
             # remap persisted dictionary ids into the live dictionary

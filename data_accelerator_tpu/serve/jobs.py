@@ -562,17 +562,23 @@ class K8sJobClient(TpuJobClient):
         container["image"] = self.image
         if job.get("confPath"):
             container["args"] = [f"conf={job['confPath']}"]
+        # append unconditionally (the template always carries an
+        # explicit command, so args never shadow an image CMD): a
+        # manifest without args must NOT silently drop the replica's
+        # partition assignment — a pod running with the default
+        # replicaindex=1/replicacount=1 owns every partition and
+        # duplicates the rest of the group's processing
+        args = container.setdefault("args", [])
         if job.get("batches"):
-            container["args"].append(f"batches={job['batches']}")
-        if job.get("parentTrace") and container.get("args"):
+            args.append(f"batches={job['batches']}")
+        if job.get("parentTrace"):
             # same key=value conf-override contract as the local client
-            container["args"].append(
+            args.append(
                 "datax.job.process.telemetry.parenttrace="
                 f"{job['parentTrace']}"
             )
-        if container.get("args"):
-            for k, v in (job.get("confOverrides") or {}).items():
-                container["args"].append(f"{k}={v}")
+        for k, v in (job.get("confOverrides") or {}).items():
+            args.append(f"{k}={v}")
         return manifest
 
     def _jobs_url(self, name: Optional[str] = None) -> str:
@@ -828,6 +834,52 @@ class JobOperation:
             "datax.job.process.state.partitions": str(partitions),
         }
 
+    def _apply_member_assignment(
+        self, rec: dict, position: int, count: int, partitions: int,
+        pmap: Dict[int, List[int]],
+    ) -> dict:
+        """Put one PRE-EXISTING group member (the base job at position
+        1, surviving replicas after it) onto the new partition map: its
+        position's overrides merge into the record and, when the
+        effective assignment changed, the member is restarted so the
+        running process picks the map up (conf is read at host start).
+        Without this the base would keep running replicacount=1 after a
+        1->2 scale-up and own EVERY partition alongside the new replica
+        — duplicate processing under the key-routed ingest filter and
+        both replicas clobbering the same mirror prefixes. The rescale
+        only returns once every member runs the same map."""
+        from ..runtime.statepartition import DEFAULT_STATE_PARTITIONS
+
+        target = self._replica_conf_overrides(position, count, partitions)
+        current = dict(rec.get("confOverrides") or {})
+        # what a host with no overrides assumes — a base job started
+        # before any rescale carries none, yet already runs this map
+        defaults = self._replica_conf_overrides(
+            1, 1, DEFAULT_STATE_PARTITIONS
+        )
+        changed = any(
+            current.get(k, defaults[k]) != v for k, v in target.items()
+        )
+        rec["confOverrides"] = {**current, **target}
+        rec["statePartitionsOwned"] = sorted(pmap.get(position, []))
+        if changed and rec.get("state") in (
+            JobState.Running, JobState.Starting,
+        ):
+            with tracing.span("rescale/restart", job=rec["name"]):
+                rec = self.client.stop(rec)
+                self.registry.upsert(rec)
+                deadline = time.time() + 10
+                while time.time() < deadline and self.client.get_state(
+                    rec
+                ) in (JobState.Running, JobState.Starting):
+                    time.sleep(self.retry_interval_s)
+                parent = tracing.format_parent(tracing.capture())
+                if parent is not None:
+                    rec["parentTrace"] = parent
+                rec = self.client.submit(rec)
+        self.registry.upsert(rec)
+        return rec
+
     def rescale(self, job_name: str, replicas: int) -> List[dict]:
         """In-place replica scaling — the path a replica-count change
         used to require a stop+start for. ``replicas`` counts the base
@@ -837,23 +889,44 @@ class JobOperation:
         (``FleetAdmissionGate.admit_replicas`` — capacity codes over N
         copies of the flow's footprint); scale-DOWN stops the
         highest-numbered replicas first. The admitted plan carries the
-        state-partition map (``_state_partition_plan``): every spawned
-        replica gets its contiguous partition range as conf overrides,
-        so stateful flows hand partitions off instead of losing them.
-        The replanner refreshes placement after every change. Returns
-        the live record set (base + replicas)."""
+        state-partition map (``_state_partition_plan``): EVERY member
+        of the new replica set — the base job and surviving replicas
+        included, restarted when their assignment changed — runs its
+        contiguous partition range as conf overrides, so stateful
+        flows hand partitions off instead of losing them (and never
+        double-own one). The replanner refreshes placement after every
+        change. Returns the live record set (base + replicas)."""
         base = self.sync_job_state(job_name)
         replicas = max(1, int(replicas))
         live = self.replica_records(job_name)
         have = 1 + len(live)
+        if replicas > have and self.admission_gate is not None:
+            # raises FleetAdmissionError (recording the rejection on
+            # the base record) before the client spawns anything AND
+            # before the partition plan lands on the record — a
+            # rejected scale-up must not persist a map describing a
+            # replica set that never materialized
+            self.admission_gate.admit_replicas(base, replicas)
         pmap = self._state_partition_plan(base, replicas)
         partitions = int(base["statePartitions"])
         self.registry.upsert(base)
+        if replicas < have:
+            # stop the highest-numbered replicas first (the base job is
+            # never stopped by a rescale — replicas floor at 1), BEFORE
+            # survivors re-conf: their orphaned partitions come from
+            # the stopped tail, never from a still-running member
+            for rec in list(reversed(live))[: have - replicas]:
+                rec = self.client.stop(rec)
+                self.registry.upsert(rec)
+            live = live[: replicas - 1]
+        # every pre-existing member adopts the new map before any
+        # successor spawns: shrinking ranges first means a partition is
+        # at worst transiently unowned, never owned twice
+        for position, rec in enumerate([base] + live, start=1):
+            self._apply_member_assignment(
+                rec, position, replicas, partitions, pmap
+            )
         if replicas > have:
-            if self.admission_gate is not None:
-                # raises FleetAdmissionError (recording the rejection
-                # on the base record) before the client spawns anything
-                self.admission_gate.admit_replicas(base, replicas)
             taken = {r.get("replicaIndex") for r in live}
             idx = 2
             for i in range(replicas - have):
@@ -884,12 +957,6 @@ class JobOperation:
                     rec = self.client.submit(rec)
                 self.registry.upsert(rec)
                 live.append(rec)
-        elif replicas < have:
-            # stop the highest-numbered replicas first (the base job is
-            # never stopped by a rescale — replicas floor at 1)
-            for rec in list(reversed(live))[: have - replicas]:
-                rec = self.client.stop(rec)
-                self.registry.upsert(rec)
         self._notify_replanner()
         return [base] + self.replica_records(job_name)
 

@@ -610,7 +610,10 @@ def test_rescale_up_then_down_in_place(tmp_path, monkeypatch):
     replans_before = ops.placement.replans
 
     records = ops.jobs.rescale(job["name"], 3)
-    assert len(spy.calls) == 3  # base + two replicas
+    # base restarted onto the 3-replica partition map + two replicas
+    # (the whole group must run the same map — a base left on
+    # replicacount=1 would own every partition alongside the replicas)
+    assert len(spy.calls) == 4
     assert [r["name"] for r in records] == [
         job["name"], f"{job['name']}-r2", f"{job['name']}-r3",
     ]
@@ -620,7 +623,9 @@ def test_rescale_up_then_down_in_place(tmp_path, monkeypatch):
     assert ops.placement.replans > replans_before  # placement refreshed
 
     records = ops.jobs.rescale(job["name"], 1)
-    assert len(spy.calls) == 3  # scale-down spawns nothing
+    # scale-down spawns no replicas; the surviving base restarts once
+    # to adopt the 1-replica map
+    assert len(spy.calls) == 5
     assert [r["name"] for r in records] == [job["name"]]
     assert ops.registry.get(
         f"{job['name']}-r3"

@@ -163,6 +163,36 @@ class TestK8sJobClient:
         job = ops.restart_job("f1")
         assert job["state"] == JobState.Starting
 
+    def test_conf_overrides_survive_argless_manifest(self, k8s, tmp_path):
+        """A manifest whose container carries no args must NOT silently
+        drop the replica's partition assignment — a pod running the
+        default replicaindex=1/replicacount=1 would own every partition
+        alongside the rest of the group."""
+        import yaml
+
+        _fake, client = k8s
+        base = yaml.safe_load(open(client.manifest_path, encoding="utf-8")
+                              .read().replace("FLOWNAME", "f")
+                              .replace("JOBNAME", "j"))
+        del base["spec"]["template"]["spec"]["containers"][0]["args"]
+        stripped = tmp_path / "noargs.yaml"
+        stripped.write_text(yaml.safe_dump(base), encoding="utf-8")
+        client.manifest_path = str(stripped)
+        m = client.render_manifest({
+            "name": "f1-r2",
+            "confOverrides": {
+                "datax.job.process.state.replicaindex": "2",
+                "datax.job.process.state.replicacount": "2",
+            },
+            "parentTrace": "00-abc-def-01",
+        })
+        args = m["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "datax.job.process.state.replicaindex=2" in args
+        assert "datax.job.process.state.replicacount=2" in args
+        assert any(a.startswith(
+            "datax.job.process.telemetry.parenttrace="
+        ) for a in args)
+
     def test_factory(self):
         c = make_job_client({"type": "k8s", "apiserver": "https://x:1",
                              "namespace": "ns"})

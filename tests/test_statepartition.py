@@ -392,6 +392,29 @@ def test_statetable_both_sides_bad_loads_empty_with_dx531(tmp_path):
     assert any(e["code"] == "DX531" for e in events)
 
 
+def test_statetable_absent_active_never_loads_uncommitted_standby(tmp_path):
+    """A crash between overwrite() (standby written, in-memory flip)
+    and persist() (pointer never committed) leaves a fresh partition
+    with pointer=None -> default active 'A' and side A absent. The
+    loader must load EMPTY — falling through to side B would apply the
+    UNCOMMITTED batch, and the replayed un-acked window on top of it
+    double-counts non-idempotent accumulators."""
+    from data_accelerator_tpu.core.schema import StringDictionary
+    from data_accelerator_tpu.runtime.statetable import StateTable
+
+    d = StringDictionary()
+    st = StateTable("acc", _schema(), 32, str(tmp_path), partitions=4)
+    st.overwrite(_table([(1, 1.0)]), d)  # standby (B) written, no commit
+    p = partition_of(1, 4)
+    assert LocalSnapshotStore(str(tmp_path)).get_pointer(f"p{p:02d}") is None
+    assert (tmp_path / f"p{p:02d}" / "B" / "table.npz").exists()
+    stats, events = {}, []
+    st2 = StateTable("acc", _schema(), 32, str(tmp_path), partitions=4,
+                     stats=stats, events=events)
+    assert _as_map(st2.load(StringDictionary())) == {}
+    assert "LoadFallback_Count" not in stats  # absent != corrupt
+
+
 def test_statetable_string_partition_key_and_remap(tmp_path):
     """String keys hash by decoded value and remap through meta.json
     into a fresh process's dictionary."""
@@ -495,6 +518,27 @@ def test_window_split_covers_every_row_exactly_once():
         int(p["rings"]["T"]["valid"].sum()) for p in parts.values()
     )
     assert total == 24
+
+
+def test_window_split_compacts_to_member_rows():
+    """A partition snapshot ships only its member rows (re-packed per
+    slot, width truncated to the widest slot) plus the original ring
+    capacity as ``cap`` — not P masked copies of the entire ring."""
+    snap = _win_snap()
+    parts = split_window_snapshot(snap, 8, {"T": ("k", "long")})
+    for part in parts.values():
+        ring = part["rings"]["T"]
+        assert ring["cap"] == 8
+        widest = int(ring["valid"].sum(axis=1).max())
+        assert ring["valid"].shape == (3, widest)
+        for a in ring["cols"].values():
+            assert a.shape == ring["valid"].shape
+    # ...and the shipped cell count is bounded by slots x member rows
+    # (worst case: every member alone in its slot), not P x ring size
+    total_cells = sum(
+        p["rings"]["T"]["valid"].size for p in parts.values()
+    )
+    assert total_cells <= 3 * 24  # vs 8 partitions x 24 uncompacted
 
 
 def test_window_split_merge_roundtrip_repacks_rows():
@@ -658,9 +702,13 @@ def test_rescale_carries_partition_map_and_conf_overrides(tmp_path):
     assert sorted(int(p) for parts in pmap.values() for p in parts) == \
         list(range(DEFAULT_STATE_PARTITIONS))
     assert set(pmap) == {"1", "2", "3"}
-    # every spawned replica received its contiguous range as conf
-    # overrides (the args LocalJobClient appends as key=value)
-    assert len(client.submitted) == 2
+    # EVERY member of the new set runs its contiguous range as conf
+    # overrides (the args LocalJobClient appends as key=value): the
+    # base is RESTARTED onto the new map — left alone it would keep
+    # replicacount=1 and own every partition alongside the replicas
+    assert len(client.submitted) == 3  # base restart + two replicas
+    assert client.stopped == ["flow1-job"]
+    assert client.submitted[0]["name"] == "flow1-job"
     for rec in client.submitted:
         ov = rec["confOverrides"]
         assert ov["datax.job.process.state.replicacount"] == "3"
@@ -680,7 +728,41 @@ def test_rescale_down_records_reassignment(tmp_path):
     # the scale-down handed replica 2's range back to replica 1
     assert base["statePartitionsReassigned"] == \
         partition_map(2, DEFAULT_STATE_PARTITIONS)[2]
-    assert client.stopped == ["flow1-job-r2"]
+    # r2 stopped FIRST, then the surviving base restarted onto the
+    # 1-replica map (each rescale also restarts the base: stop+submit)
+    assert client.stopped == ["flow1-job", "flow1-job-r2", "flow1-job"]
+
+
+def test_rescale_reconfs_every_member_onto_one_map(tmp_path):
+    """The whole group runs the SAME map after a rescale: the base and
+    surviving replicas are re-conf'd (restarted) with their position's
+    overrides, ownership covers every partition exactly once, and a
+    no-op rescale restarts nothing."""
+    ops, client, registry = _ops(tmp_path)
+    ops.rescale("flow1-job", 2)
+    base_sub = client.submitted[0]
+    assert base_sub["name"] == "flow1-job"
+    ov = base_sub["confOverrides"]
+    assert ov["datax.job.process.state.replicaindex"] == "1"
+    assert ov["datax.job.process.state.replicacount"] == "2"
+    owned = [
+        registry.get(n)["statePartitionsOwned"]
+        for n in ("flow1-job", "flow1-job-r2")
+    ]
+    flat = sorted(p for o in owned for p in o)
+    assert flat == list(range(DEFAULT_STATE_PARTITIONS))  # exactly once
+    # scale-down: the survivor re-confs to own the whole key space
+    ops.rescale("flow1-job", 1)
+    base = registry.get("flow1-job")
+    assert base["confOverrides"][
+        "datax.job.process.state.replicacount"
+    ] == "1"
+    assert base["statePartitionsOwned"] == \
+        list(range(DEFAULT_STATE_PARTITIONS))
+    # idempotent: same target, same map — nothing stops or spawns
+    n_stop, n_sub = len(client.stopped), len(client.submitted)
+    ops.rescale("flow1-job", 1)
+    assert (len(client.stopped), len(client.submitted)) == (n_stop, n_sub)
 
 
 def test_local_client_passes_conf_overrides_as_args(tmp_path):
