@@ -145,6 +145,10 @@ def runtime_conformance_model(
             {
                 "name": s.get("name"),
                 "kind": s.get("kind"),
+                # rows ride along so the latency model can derive the
+                # per-batch ingest row count (input-kind stages) for
+                # the calibrated host-decode term
+                "rows": s.get("rows"),
                 "hbmBytes": s.get("hbmBytes"),
                 "d2hBytes": s.get("d2hBytes"),
                 "flops": s.get("flops"),
@@ -195,6 +199,33 @@ def transfer_time_ms(bytes_: float, gbps: Optional[float]) -> Optional[float]:
     return float(bytes_) / float(gbps) / 1e6
 
 
+def decode_time_ms(
+    input_rows: float, profile: Dict[str, float],
+) -> Optional[float]:
+    """The calibrated host-decode term: milliseconds to run
+    ``input_rows`` through the native ingest decoder at the machine's
+    measured rate (``decode_rows_per_sec``, obs/calibrate.py's decoder
+    probe over a reference payload). None when the machine has no
+    calibrated decode rate (native library unavailable) or the model
+    carries no input rows — the missing-prediction posture (silence)
+    applies, like every other absent term."""
+    rate = profile.get("decode_rows_per_sec")
+    if not rate or not input_rows:
+        return None
+    return float(input_rows) / float(rate) * 1000.0
+
+
+def model_input_rows(stages: list) -> float:
+    """Per-batch ingest row count of a stage list (dict-shaped): the
+    summed capacities of the input-kind stages — the rows the host
+    decoder must produce each batch."""
+    return float(sum(
+        float(s.get("rows") or 0.0)
+        for s in (stages or [])
+        if s.get("kind") == "input"
+    ))
+
+
 def latency_model(
     stages: list,
     totals: Dict[str, object],
@@ -203,13 +234,15 @@ def latency_model(
 ) -> dict:
     """The ``latencyModel`` report block: per-stage roofline ms plus
     the batch-level decomposition the runtime stages map onto —
-    ``deviceStepMs`` (every stage's compute, one dispatch overhead),
-    ``d2hMs`` (the full-fetch output transfer), ``iciMs`` (the DX7xx
-    wire bytes over the calibrated link). ``stages``/``totals`` are
-    dict-shaped (``StageCost.to_dict()`` / ``DevicePlanReport.totals()``
-    or the conf-embedded runtime model). Consumed by the ``--device``
-    report, the designer Validate cost table, bench.py's roofline
-    block, and the host's DX520/DX521 predictions."""
+    ``decodeMs`` (the calibrated host-decode term over the input-stage
+    rows), ``deviceStepMs`` (every stage's compute, one dispatch
+    overhead), ``d2hMs`` (the full-fetch output transfer), ``iciMs``
+    (the DX7xx wire bytes over the calibrated link).
+    ``stages``/``totals`` are dict-shaped (``StageCost.to_dict()`` /
+    ``DevicePlanReport.totals()`` or the conf-embedded runtime model).
+    Consumed by the ``--device`` report, the designer Validate cost
+    table, bench.py's roofline block, and the host's DX520/DX521
+    predictions."""
     overhead_ms = float(profile.get("dispatch_overhead_us") or 0.0) / 1000.0
     out_stages = []
     compute_ms = 0.0
@@ -231,6 +264,7 @@ def latency_model(
         or totals.get("iciBytesPerBatch") or 0.0
     )
     ici_ms = transfer_time_ms(ici_bytes, profile.get("ici_gbps"))
+    decode_ms = decode_time_ms(model_input_rows(stages), profile)
     device_step_ms = compute_ms + overhead_ms
     return {
         "profileSource": profile_source,
@@ -239,18 +273,22 @@ def latency_model(
             for k in (
                 "backend", "device_kind", "hbm_read_gbps",
                 "hbm_write_gbps", "flops_gflops", "dispatch_overhead_us",
-                "d2h_gbps", "ici_gbps",
+                "d2h_gbps", "ici_gbps", "decode_rows_per_sec",
             )
         },
         "stages": out_stages,
         "totals": {
             "computeMs": round(compute_ms, 4),
             "dispatchOverheadMs": round(overhead_ms, 4),
+            "decodeMs": (
+                round(decode_ms, 4) if decode_ms is not None else None
+            ),
             "deviceStepMs": round(device_step_ms, 4),
             "d2hMs": round(d2h_ms, 4) if d2h_ms is not None else None,
             "iciMs": round(ici_ms, 4) if ici_ms is not None else None,
             "batchMs": round(
-                device_step_ms + (d2h_ms or 0.0) + (ici_ms or 0.0), 4
+                device_step_ms + (decode_ms or 0.0) + (d2h_ms or 0.0)
+                + (ici_ms or 0.0), 4
             ),
         },
     }
@@ -260,11 +298,18 @@ def stage_latency_predictions(model: dict) -> Dict[str, float]:
     """Map a ``latency_model()`` block onto the runtime histogram
     stages the host measures (constants.MetricName.STAGES): the DX520
     comparison keys. Only stages the model can actually predict appear
-    — ``device-step`` (compute + one dispatch overhead) and ``collect``
-    (the D2H landing of the output tables). Decode/sinks/checkpoint are
-    host-side I/O the device model deliberately does not cover."""
+    — ``decode`` (the calibrated host-decode rate over the flow's
+    input rows), ``device-step`` (compute + one dispatch overhead) and
+    ``collect`` (the D2H landing of the output tables).
+    Sinks/checkpoint are host-side I/O the model deliberately does not
+    cover. Like every roofline term the decode prediction is a LOWER
+    bound (a saturated decoder at the calibrated rate; the runtime
+    decode span also contains the source poll), judged under the wide
+    DX520 band and the sub-floor silence rule."""
     totals = model.get("totals") or {}
     out: Dict[str, float] = {}
+    if totals.get("decodeMs"):
+        out["decode"] = float(totals["decodeMs"])
     if totals.get("deviceStepMs"):
         out["device-step"] = float(totals["deviceStepMs"])
     if totals.get("d2hMs"):

@@ -97,6 +97,18 @@ class MetricName:
         r"IngestRateScale",
         r"Input_[A-Za-z0-9_.]+_Events_Count",
         r"Input_[A-Za-z0-9_.]+_Count",
+        # Kafka record batches skipped by the per-batch CRC-32C check
+        # (runtime/kafka_wire.py decode_record_batches + the native
+        # walker) — covered by the Input_*_Count family above, listed
+        # explicitly because the pilot/alert surfaces reference it
+        r"Input_CorruptBatch_Count",
+        # ingest decode fast path (native/decoder.cpp via
+        # runtime/processor.py encode_json_bytes): conf'd decoder shard
+        # count in effect, last measured decode rate, and reuses of the
+        # pooled transfer-ready ingest matrices since the last collect
+        r"Decode_Shards",
+        r"Decode_RowsPerSec",
+        r"Decode_BufferReuse_Count",
         r"Output_[A-Za-z0-9_.]+_Events_Count",
         r"Output_[A-Za-z0-9_.]+_(GroupsDropped|JoinRowsDropped)",
         r"Sink_[a-z]+",
@@ -159,6 +171,10 @@ class MetricName:
         r"Calib_DispatchOverheadUs",
         r"Calib_D2HGBps",
         r"Calib_IciGBps",
+        # measured host JSON-decode rate (native decoder probe) — the
+        # constant pricing the latency model's decode term, the DX520
+        # baseline for stage_decode_ms
+        r"Calib_DecodeRowsPerSec",
         # live HBM watermark sampler (runtime/processor.py
         # device_memory_stats, exported per batch when the backend
         # reports allocator stats)

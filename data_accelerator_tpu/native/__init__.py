@@ -1,3 +1,15 @@
-from .decoder import NativeDecoder, native_available
+from .decoder import (
+    KAFKA_CODEC_NAMES,
+    NativeDecoder,
+    PackedBufferPool,
+    native_available,
+    native_crc32c,
+)
 
-__all__ = ["NativeDecoder", "native_available"]
+__all__ = [
+    "KAFKA_CODEC_NAMES",
+    "NativeDecoder",
+    "PackedBufferPool",
+    "native_available",
+    "native_crc32c",
+]

@@ -6,10 +6,33 @@ executor-side ``from_json`` plays in the reference
 in native code straight into numpy buffers. The shared library builds
 lazily with g++ on first use and is cached next to the source.
 
+Three decode surfaces:
+
+- ``decode``: newline-JSON -> per-column numpy arrays (the row layout;
+  the mesh path and golden-parity tests use it);
+- ``decode_packed``: newline-JSON straight into a persistent
+  [n_cols+1, capacity] int32 matrix — the exact single-transfer H2D
+  layout ``runtime/processor.py pack_raw`` builds, so the hot path
+  performs zero per-batch column allocations and no pack copy. The
+  matrices come from a :class:`PackedBufferPool` (64-byte-aligned, so
+  the CPU backend's ``jnp.asarray`` transfer is zero-copy) and are
+  double-buffered against the pipelined in-flight window by the
+  processor (a slot is only reused after its batch lands or abandons);
+- ``decode_kafka_packed``: native Kafka v2 record-batch walking
+  (varint framing, CRC-32C verification, control-batch skip,
+  typed rejection of compressed batches) feeding each record value to
+  the same JSON column decoder in the same call — the production wire
+  format never touches a Python object per record.
+
 The decoder owns a string dictionary (string -> int32) kept consistent
 with the Python ``StringDictionary`` by push-before/pull-after syncs
 around each decode call; both sides assign ids sequentially so ids
 stay stable across the boundary.
+
+Shard count: ``datax.job.process.ingest.decoderthreads`` (plumbed via
+the ``threads`` ctor arg) > ``DATAX_DECODER_THREADS`` env override >
+the engine default (cap 4 — ingest shares the host with the engine
+loop and sinks).
 """
 
 from __future__ import annotations
@@ -19,7 +42,7 @@ import logging
 import os
 import subprocess
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -52,6 +75,17 @@ _NP_DTYPE = {
     ColType.STRING: np.int32,
     ColType.TIMESTAMP: np.int64,
 }
+
+# Kafka v2 attribute codec ids (message format v2)
+KAFKA_CODEC_NAMES = {1: "gzip", 2: "snappy", 3: "lz4", 4: "zstd"}
+
+# dx_decode_kafka_packed stats vector layout (decoder.cpp KStat)
+_KSTAT_RECORDS = 0
+_KSTAT_MALFORMED = 1
+_KSTAT_CORRUPT = 2
+_KSTAT_CONTROL = 3
+_KSTAT_OVERFLOW = 4
+_KSTAT_CODEC = 5
 
 
 def _build_library() -> Optional[str]:
@@ -100,6 +134,22 @@ def _load():
             ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
         ]
+        lib.dx_decode_packed.restype = ctypes.c_int64
+        lib.dx_decode_packed.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+        ]
+        lib.dx_decode_kafka_packed.restype = ctypes.c_int64
+        lib.dx_decode_kafka_packed.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+        ]
+        lib.dx_crc32c.restype = ctypes.c_uint32
+        lib.dx_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_int64]
         lib.dx_bad_timestamps.restype = ctypes.c_int64
         lib.dx_bad_timestamps.argtypes = [ctypes.c_void_p]
         lib.dx_dict_size.restype = ctypes.c_int64
@@ -118,30 +168,103 @@ def native_available() -> bool:
     return _load() is not None
 
 
-def _decode_threads() -> int:
-    """Worker count for parallel decode (DATAX_DECODER_THREADS
-    overrides; default caps at 4 — ingest shares the host with the
-    engine loop and sinks)."""
+def native_crc32c(data: bytes) -> Optional[int]:
+    """CRC-32C via the native library (None when unavailable) — shared
+    with the wire client so checksum math exists exactly once."""
+    lib = _load()
+    if lib is None:
+        return None
+    return int(lib.dx_crc32c(data, len(data)))
+
+
+def _decode_threads(conf_threads: Optional[int] = None) -> int:
+    """Decoder shard count: DATAX_DECODER_THREADS env (operator
+    override) > the conf'd ``process.ingest.decoderthreads`` > default
+    (cap 4 — ingest shares the host with the engine loop and sinks)."""
     env = os.environ.get("DATAX_DECODER_THREADS")
     if env:
         try:
             return max(1, int(env))
         except ValueError:
             pass
+    if conf_threads is not None:
+        return max(1, int(conf_threads))
     return max(1, min(4, (os.cpu_count() or 1) - 1))
 
 
-class NativeDecoder:
-    """Decode newline-delimited JSON event batches into columnar numpy
-    arrays typed by the flow's input schema."""
+class PackedBufferPool:
+    """Persistent, reused, 64-byte-aligned ingest matrices in the
+    packed H2D layout ([n_rows, capacity] int32, row stride ==
+    capacity).
 
-    def __init__(self, schema: Schema, dictionary: StringDictionary):
+    64-byte alignment makes the CPU backend's ``jnp.asarray`` a
+    zero-copy view (the same property PR 13 had to defend against for
+    ring snapshots) — which is exactly why a matrix may NOT be reused
+    while its batch is still in flight: the device step reads the
+    buffer directly. The processor releases a slot only once its
+    ``PendingBatch`` has landed (or abandoned after the step
+    completed), double-buffering the pool against the pipelined
+    window. The pool grows on demand (decode-ahead at depth N holds up
+    to N+1 matrices) and every reuse is counted for the
+    ``Decode_BufferReuse_Count`` metric."""
+
+    def __init__(self, n_rows: int, capacity: int):
+        self.n_rows = int(n_rows)
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._free: List[np.ndarray] = []
+        self.alloc_count = 0
+        self.reuse_count = 0
+        self._reuse_drained = 0
+
+    def _new_matrix(self) -> np.ndarray:
+        n = self.n_rows * self.capacity
+        raw = np.empty(n + 16, dtype=np.int32)
+        off = (-raw.ctypes.data % 64) // 4
+        m = raw[off: off + n].reshape(self.n_rows, self.capacity)
+        assert m.ctypes.data % 64 == 0 and m.flags["C_CONTIGUOUS"]
+        return m
+
+    def acquire(self) -> np.ndarray:
+        with self._lock:
+            if self._free:
+                self.reuse_count += 1
+                return self._free.pop()
+            self.alloc_count += 1
+        return self._new_matrix()
+
+    def release(self, matrix: np.ndarray) -> None:
+        with self._lock:
+            self._free.append(matrix)
+
+    def take_reuse_count(self) -> int:
+        """Reuses since the last take (the Decode_BufferReuse_Count
+        delta drained at collect)."""
+        with self._lock:
+            n = self.reuse_count - self._reuse_drained
+            self._reuse_drained = self.reuse_count
+            return n
+
+
+class NativeDecoder:
+    """Decode newline-delimited JSON (or Kafka v2 record batches) into
+    columnar output typed by the flow's input schema."""
+
+    def __init__(
+        self,
+        schema: Schema,
+        dictionary: StringDictionary,
+        threads: Optional[int] = None,
+    ):
         lib = _load()
         if lib is None:
             raise RuntimeError("native decoder unavailable (g++ build failed)")
         self._lib = lib
         self.schema = schema
         self.dictionary = dictionary
+        # conf'd shard count (datax.job.process.ingest.decoderthreads);
+        # None = engine default, env DATAX_DECODER_THREADS always wins
+        self.threads = threads
         desc = "".join(
             f"{c.name}\t{_CTYPE_NAME[c.ctype]}\n" for c in schema.columns
         )
@@ -149,6 +272,7 @@ class NativeDecoder:
         self._cols = list(schema.columns)
         self._synced = 0
         self.last_bad_timestamps = 0
+        self.last_shards = 1
         self._push_python_entries()
 
     def close(self):
@@ -161,6 +285,9 @@ class NativeDecoder:
             self.close()
         except Exception:
             pass
+
+    def shard_count(self) -> int:
+        return _decode_threads(self.threads)
 
     # -- dictionary sync --------------------------------------------------
     def _push_python_entries(self):
@@ -202,14 +329,14 @@ class NativeDecoder:
     def decode(
         self, data: bytes, max_rows: int
     ) -> Tuple[Dict[str, np.ndarray], np.ndarray, int, int]:
-        """Returns (columns, valid, rows, bytes_consumed).
+        """Row-layout decode: returns (columns, valid, rows,
+        bytes_consumed).
 
-        ``valid`` is the ONLY authoritative row mask: on the parallel
-        path (payloads over ~1MB) malformed lines leave zeroed gap
-        slots at chunk tails, so valid rows are NOT a packed prefix and
-        ``arrays[:rows]`` would both drop real rows and include gaps.
-        ``rows`` is the decoded-row COUNT (== valid.sum()), for
-        metrics."""
+        ``valid`` is the ONLY authoritative row mask: on the sharded
+        path malformed lines leave zeroed gap slots at chunk tails, so
+        valid rows are NOT a packed prefix and ``arrays[:rows]`` would
+        both drop real rows and include gaps. ``rows`` is the
+        decoded-row COUNT (== valid.sum()), for metrics."""
         self._push_python_entries()
         arrays: Dict[str, np.ndarray] = {}
         ptrs = (ctypes.c_void_p * len(self._cols))()
@@ -219,10 +346,8 @@ class NativeDecoder:
             ptrs[i] = a.ctypes.data_as(ctypes.c_void_p)
         valid = np.zeros(max_rows, dtype=np.uint8)
         consumed = ctypes.c_int64(0)
-        # parallel decode for big payloads: newline-chunked worker
-        # threads with a serial dictionary merge (decoder.cpp
-        # dx_decode_mt); small payloads stay on the single-thread path
-        n_threads = _decode_threads()
+        n_threads = self.shard_count()
+        self.last_shards = n_threads
         rows = self._lib.dx_decode_mt(
             self._d, data, len(data), max_rows, ptrs,
             valid.ctypes.data_as(ctypes.c_void_p), ctypes.byref(consumed),
@@ -231,3 +356,85 @@ class NativeDecoder:
         self.last_bad_timestamps = int(self._lib.dx_bad_timestamps(self._d))
         self._pull_native_entries()
         return arrays, valid.astype(bool), int(rows), int(consumed.value)
+
+    def _packed_args(
+        self, matrix: np.ndarray, col_rows: Sequence[int], valid_row: int,
+    ):
+        if matrix.dtype != np.int32 or not matrix.flags["C_CONTIGUOUS"]:
+            raise ValueError("packed decode needs a C-contiguous int32 matrix")
+        cr = (ctypes.c_int64 * len(self._cols))(*[int(r) for r in col_rows])
+        return (
+            matrix.ctypes.data_as(ctypes.c_void_p),
+            int(matrix.shape[1]), cr, int(valid_row),
+        )
+
+    def decode_packed(
+        self,
+        data: bytes,
+        matrix: np.ndarray,
+        col_rows: Sequence[int],
+        valid_row: int,
+        base_ms: int,
+        max_rows: Optional[int] = None,
+    ) -> Tuple[int, int]:
+        """Newline-JSON straight into the packed H2D matrix: column i
+        of the schema writes matrix row ``col_rows[i]`` (floats
+        bitcast, bools widened, timestamps rebased to int32
+        batch-relative ms against ``base_ms``), validity into
+        ``matrix[valid_row]`` as int32 0/1. The decoder zeroes its own
+        rows first, so reused (dirty) pool matrices are fine. Returns
+        (rows decoded, bytes consumed)."""
+        self._push_python_entries()
+        base, stride, cr, vrow = self._packed_args(matrix, col_rows, valid_row)
+        cap = int(matrix.shape[1]) if max_rows is None else int(max_rows)
+        consumed = ctypes.c_int64(0)
+        n_threads = self.shard_count()
+        self.last_shards = n_threads
+        rows = self._lib.dx_decode_packed(
+            self._d, data, len(data), cap, base, stride, cr, vrow,
+            int(base_ms), ctypes.byref(consumed), n_threads,
+        )
+        self.last_bad_timestamps = int(self._lib.dx_bad_timestamps(self._d))
+        self._pull_native_entries()
+        return int(rows), int(consumed.value)
+
+    def decode_kafka_packed(
+        self,
+        data: bytes,
+        matrix: np.ndarray,
+        col_rows: Sequence[int],
+        valid_row: int,
+        base_ms: int,
+        max_rows: Optional[int] = None,
+    ) -> Tuple[int, Dict[str, int]]:
+        """Kafka v2 record batches straight into the packed H2D matrix
+        — CRC-32C verified per batch (corrupt batches skip + count
+        instead of mis-parsing), control batches skipped, compressed
+        batches rejected with a typed :class:`UnsupportedCodecError`
+        naming the codec. Returns (rows decoded, stats) where stats
+        carries ``records``/``malformed``/``corrupt_batches``/
+        ``control_batches``/``overflow_dropped``."""
+        self._push_python_entries()
+        base, stride, cr, vrow = self._packed_args(matrix, col_rows, valid_row)
+        cap = int(matrix.shape[1]) if max_rows is None else int(max_rows)
+        stats = (ctypes.c_int64 * 6)()
+        n_threads = self.shard_count()
+        self.last_shards = n_threads
+        rows = self._lib.dx_decode_kafka_packed(
+            self._d, data, len(data), cap, base, stride, cr, vrow,
+            int(base_ms), stats, n_threads,
+        )
+        self.last_bad_timestamps = int(self._lib.dx_bad_timestamps(self._d))
+        self._pull_native_entries()
+        codec = int(stats[_KSTAT_CODEC])
+        if codec >= 0:
+            from ..runtime.kafka_wire import UnsupportedCodecError
+
+            raise UnsupportedCodecError(KAFKA_CODEC_NAMES.get(codec, str(codec)))
+        return int(rows), {
+            "records": int(stats[_KSTAT_RECORDS]),
+            "malformed": int(stats[_KSTAT_MALFORMED]),
+            "corrupt_batches": int(stats[_KSTAT_CORRUPT]),
+            "control_batches": int(stats[_KSTAT_CONTROL]),
+            "overflow_dropped": int(stats[_KSTAT_OVERFLOW]),
+        }

@@ -59,7 +59,12 @@ DISPATCH_REPS = 10
 
 # the version stamp persisted profiles carry; bump when probe semantics
 # change so stale cached profiles recalibrate instead of mispredicting
-PROFILE_VERSION = 1
+# (v2 added the host JSON-decode probe — decode_rows_per_sec)
+PROFILE_VERSION = 2
+
+# decode probe sizing: enough rows that per-call overhead vanishes,
+# small enough to stay ~10 ms
+DECODE_PROBE_ROWS = 20_000
 
 
 @dataclass
@@ -74,6 +79,11 @@ class MachineProfile:
     dispatch_overhead_us: float
     d2h_gbps: float
     ici_gbps: Optional[float] = None
+    # measured native ingest-decode rate over the reference payload
+    # (rows/s; None when the native library is unavailable) — prices
+    # the latency model's host-decode term so DX520 can judge
+    # stage_decode_ms
+    decode_rows_per_sec: Optional[float] = None
     probe_ms: float = 0.0
     version: int = PROFILE_VERSION
 
@@ -99,6 +109,8 @@ class MachineProfile:
         }
         if self.ici_gbps is not None:
             out["Calib_IciGBps"] = self.ici_gbps
+        if self.decode_rows_per_sec is not None:
+            out["Calib_DecodeRowsPerSec"] = self.decode_rows_per_sec
         return out
 
 
@@ -111,6 +123,50 @@ def _best_seconds(fn, reps: int = PROBE_REPS) -> float:
         fn()
         best = min(best, time.perf_counter() - t0)
     return max(best, 1e-9)
+
+
+def _probe_decode_rate() -> Optional[float]:
+    """Measure the native ingest decoder on a reference IoT-shaped
+    payload (nested object, string + numeric + timestamp columns) —
+    rows/s on THIS host, the constant the latency model's decode term
+    is priced with. None when the native library is unavailable (the
+    decode prediction then stays silent, like a missing ICI link)."""
+    try:
+        import json
+
+        from ..core.schema import Schema, StringDictionary
+        from ..native import NativeDecoder, native_available
+
+        if not native_available():
+            return None
+        schema = Schema.from_spark_json(json.dumps({
+            "type": "struct",
+            "fields": [
+                {"name": "d", "type": {"type": "struct", "fields": [
+                    {"name": "id", "type": "long", "nullable": False,
+                     "metadata": {}},
+                    {"name": "kind", "type": "string", "nullable": False,
+                     "metadata": {}},
+                    {"name": "value", "type": "double", "nullable": False,
+                     "metadata": {}},
+                ]}, "nullable": False, "metadata": {}},
+                {"name": "ts", "type": "timestamp", "nullable": True,
+                 "metadata": {}},
+            ],
+        }))
+        n = DECODE_PROBE_ROWS
+        payload = ("\n".join(
+            '{"d":{"id":%d,"kind":"K%d","value":%d.%03d},"ts":%d}'
+            % (i % 97, i % 7, i % 100, i % 1000, 1_700_000_000_000 + i)
+            for i in range(n)
+        ) + "\n").encode()
+        dec = NativeDecoder(schema, StringDictionary())
+        dec.decode(payload, n)  # warm (build/trie/dict)
+        best = _best_seconds(lambda: dec.decode(payload, n), reps=3)
+        return round(n / best, 1)
+    except Exception as e:  # noqa: BLE001 — the decode term is optional
+        logger.debug("decode-rate probe unavailable: %s", e)
+        return None
 
 
 def calibrate(device=None) -> MachineProfile:
@@ -191,6 +247,8 @@ def calibrate(device=None) -> MachineProfile:
     def bw(nb: float, s: float) -> float:
         return nb / max(s - tick_s, 1e-9) / 1e9
 
+    decode_rate = _probe_decode_rate()
+
     profile = MachineProfile(
         backend=backend,
         device_kind=str(kind),
@@ -202,6 +260,7 @@ def calibrate(device=None) -> MachineProfile:
         dispatch_overhead_us=round(tick_s * 1e6, 3),
         d2h_gbps=round(nbytes / d2h_s / 1e9, 3),
         ici_gbps=round(ici_gbps, 3) if ici_gbps else None,
+        decode_rows_per_sec=decode_rate,
         probe_ms=round((time.perf_counter() - t_start) * 1000.0, 1),
     )
     logger.info("machine profile calibrated: %s", profile.to_dict())

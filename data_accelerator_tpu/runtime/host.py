@@ -416,8 +416,10 @@ class StreamingHost:
                     # timestamps shift across a second boundary
                     batch_time_ms = now_ms
             elif hasattr(src, "poll_raw"):
-                # native ingest: raw JSON bytes -> C++ decoder; the
-                # packed matrix stays numpy (to_device=False) so the
+                # native ingest: raw wire bytes -> C++ decoder (newline
+                # JSON, or whole Kafka v2 record batches when the
+                # source declares raw_format="kafka-v2"); the packed
+                # matrix stays numpy (to_device=False) so the
                 # decode-ahead worker never touches jax off-thread —
                 # the jitted step's call transfers it
                 blob, _n, c = src.poll_raw(max_events)
@@ -425,6 +427,7 @@ class StreamingHost:
                 raw[name] = self.processor.encode_json_bytes(
                     blob, (batch_time_ms // 1000) * 1000, source=name,
                     to_device=False,
+                    fmt=getattr(src, "raw_format", "jsonl"),
                 )
             else:
                 rows, c = src.poll(max_events)
@@ -432,6 +435,22 @@ class StreamingHost:
                 raw[name] = self.processor.encode_rows(
                     rows, (batch_time_ms // 1000) * 1000, source=name
                 )
+            # source-side ingest counters (e.g. KafkaSource's malformed
+            # record values on the client-library poll path, the wire
+            # client's CRC-skipped corrupt batches) merge into the same
+            # ingest_stats/malformed_rows_total surface the decoder
+            # feeds, so the pilot's flood signal and the Input_*_Count
+            # metrics cover Kafka flows too
+            take = getattr(src, "take_ingest_stats", None)
+            if take is not None:
+                for k, v in take().items():
+                    if not v:
+                        continue
+                    self.processor.ingest_stats[k] = (
+                        self.processor.ingest_stats.get(k, 0) + v
+                    )
+                    if k == "malformed_rows":
+                        self.processor.malformed_rows_total += v
             if self.pilot is not None:
                 # saturation + malformed-rate signals for the window
                 self.pilot.observe_poll(

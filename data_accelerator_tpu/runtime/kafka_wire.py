@@ -51,6 +51,26 @@ API_LIST_OFFSETS = 2
 API_METADATA = 3
 API_SASL_HANDSHAKE = 17
 
+# v2 record-batch attribute codec ids (attributes & 0x07)
+CODEC_NAMES = {1: "gzip", 2: "snappy", 3: "lz4", 4: "zstd"}
+
+
+class UnsupportedCodecError(NotImplementedError):
+    """A compressed record batch reached a decoder that does not ship a
+    decompressor. Typed (and naming the codec) so ingest surfaces a
+    configuration error instead of mis-parsing: set broker/topic
+    ``compression.type=uncompressed`` or install
+    confluent-kafka/kafka-python."""
+
+    def __init__(self, codec: str):
+        self.codec = codec
+        super().__init__(
+            f"compressed kafka record batches ({codec}) are not supported "
+            "by the wire client; set broker/topic "
+            "compression.type=uncompressed or install "
+            "confluent-kafka/kafka-python"
+        )
+
 
 # ---------------------------------------------------------------------------
 # primitive encoding (big-endian, non-flexible protocol versions)
@@ -196,8 +216,7 @@ def encode_record_batch(
 _CRC32C_TABLE = None
 
 
-def _crc32c(data: bytes) -> int:
-    """CRC-32C (Castagnoli), the batch checksum Kafka v2 uses."""
+def _crc32c_python(data: bytes) -> int:
     global _CRC32C_TABLE
     if _CRC32C_TABLE is None:
         poly = 0x82F63B78
@@ -214,8 +233,63 @@ def _crc32c(data: bytes) -> int:
     return crc ^ 0xFFFFFFFF
 
 
+def _crc32c(data: bytes) -> int:
+    """CRC-32C (Castagnoli), the batch checksum Kafka v2 uses. Shares
+    the native decoder's slicing-by-8 implementation when the library
+    is built (checksumming every fetched batch per-byte in Python would
+    dwarf the decode it guards); pure-Python fallback otherwise."""
+    try:
+        from ..native import native_crc32c
+
+        crc = native_crc32c(data)
+        if crc is not None:
+            return crc
+    except Exception:  # noqa: BLE001 — checksum must never need native
+        pass
+    return _crc32c_python(data)
+
+
+# v2 record-batch frame layout (byte offsets within one batch frame):
+# baseOffset(8) batchLength(4) | partitionLeaderEpoch(4) magic(1)
+# crc(4) attributes(2) lastOffsetDelta(4) firstTimestamp(8)
+# maxTimestamp(8) producerId(8) producerEpoch(2) baseSequence(4)
+# recordCount(4) records... — crc covers attributes onward.
+_BATCH_HEADER = 61  # frame prefix through recordCount
+
+
+def iter_batch_spans(data: bytes):
+    """Yield one dict per COMPLETE v2 record batch in ``data`` —
+    ``{start, end, base_offset, next_offset, record_count,
+    attributes}`` — from the frame headers alone (no record decode, no
+    CRC). The raw-ingest path uses this to split a fetch into
+    record-budgeted deliveries and advance positions; a trailing
+    partial batch (normal at the fetch-size boundary) is ignored."""
+    pos = 0
+    n = len(data)
+    while n - pos >= _BATCH_HEADER:
+        base_offset, batch_len = struct.unpack_from(">qi", data, pos)
+        end = pos + 12 + batch_len
+        if batch_len < 49 or end > n:
+            break  # partial trailing batch
+        magic = data[pos + 16]
+        attributes = struct.unpack_from(">h", data, pos + 21)[0]
+        last_offset_delta = struct.unpack_from(">i", data, pos + 23)[0]
+        record_count = struct.unpack_from(">i", data, pos + 57)[0]
+        yield {
+            "start": pos,
+            "end": end,
+            "base_offset": base_offset,
+            "next_offset": base_offset + last_offset_delta + 1,
+            "record_count": record_count,
+            "attributes": attributes,
+            "magic": magic,
+        }
+        pos = end
+
+
 def decode_record_batches(
     data: bytes,
+    stats: Optional[Dict[str, int]] = None,
 ) -> Tuple[List[Tuple[int, int, bytes]], int]:
     """(records, next_offset) from a Fetch response's records bytes
     (possibly several concatenated batches; a trailing partial batch —
@@ -226,40 +300,55 @@ def decode_record_batches(
     batch, data or not (-1 when none) — the caller must advance its
     fetch position with this, not just the last data record, or a
     skipped control batch at the log tail would be refetched forever.
+
+    Every batch's CRC-32C is verified before its fields are trusted: a
+    corrupt batch (bit flip between broker and socket buffer, torn
+    page in a test fixture) is SKIPPED and counted into
+    ``stats["corrupt_batches"]`` instead of mis-parsed into garbage
+    rows — and since its header can't be trusted either, the position
+    advances only past its frame (base_offset + 1). Compressed batches
+    raise the typed :class:`UnsupportedCodecError` naming the codec.
     """
     out: List[Tuple[int, int, bytes]] = []
     next_offset = -1
-    r = Reader(data)
-    while r.remaining() >= 61:  # minimal v2 batch header size
-        try:
-            base_offset = r.i64()
-            batch_len = r.i32()
-            if r.remaining() < batch_len:
-                break  # partial trailing batch
-            body = Reader(r.read(batch_len))
-            body.i32()  # partitionLeaderEpoch
-            magic = body.i8()
-            if magic != 2:
-                logger.warning("skipping record batch magic=%d", magic)
-                continue
-            body.u32()  # crc (trusted; TCP already checksums)
-            attributes = body.i16()
-            last_offset_delta = body.i32()
-            next_offset = max(
-                next_offset, base_offset + last_offset_delta + 1
+    for span in iter_batch_spans(data):
+        base_offset = span["base_offset"]
+        if span["magic"] != 2:
+            logger.warning("skipping record batch magic=%d", span["magic"])
+            continue
+        attributes = span["attributes"]
+        if attributes & 0x07:
+            raise UnsupportedCodecError(
+                CODEC_NAMES.get(attributes & 0x07, str(attributes & 0x07))
             )
-            if attributes & 0x20:
-                # control batch (transaction commit/abort markers):
-                # metadata, not data — skipped, but next_offset above
-                # still advances past it
-                continue
-            if attributes & 0x07:
-                raise NotImplementedError(
-                    "compressed kafka record batches are not supported by "
-                    "the wire client; set broker/topic "
-                    "compression.type=uncompressed or install "
-                    "confluent-kafka/kafka-python"
+        frame = data[span["start"]: span["end"]]
+        crc_stored = struct.unpack_from(">I", frame, 17)[0]
+        if _crc32c(frame[21:]) != crc_stored:
+            if stats is not None:
+                stats["corrupt_batches"] = (
+                    stats.get("corrupt_batches", 0) + 1
                 )
+            logger.warning(
+                "skipping corrupt record batch at offset %d (CRC-32C "
+                "mismatch)", base_offset,
+            )
+            # the header past the CRC is untrusted: advance only past
+            # the frame so a corrupt tail can't teleport the position
+            next_offset = max(next_offset, base_offset + 1)
+            continue
+        next_offset = max(next_offset, span["next_offset"])
+        if attributes & 0x20:
+            # control batch (transaction commit/abort markers):
+            # metadata, not data — skipped, but next_offset above
+            # still advances past it
+            continue
+        try:
+            body = Reader(frame[12:])
+            body.i32()  # partitionLeaderEpoch
+            body.i8()   # magic
+            body.u32()  # crc (verified above)
+            body.i16()  # attributes
+            body.i32()  # lastOffsetDelta
             first_ts = body.i64()
             body.i64()  # maxTimestamp
             body.i64()  # producerId
@@ -509,6 +598,10 @@ class WireKafkaConsumer(KafkaWireClient):
         self.fetch_max_bytes = fetch_max_bytes
         self._positions: Dict[Tuple[str, int], int] = {}
         self._buffer: List[WireMessage] = []
+        # ingest-side protocol counters (corrupt batches skipped by the
+        # CRC check) — drained by KafkaSource.take_ingest_stats into
+        # the processor's Input_*_Count metrics
+        self.ingest_stats: Dict[str, int] = {}
 
     # -- consumer surface ------------------------------------------------
     def seek(self, topic: str, partition: int, offset: int) -> None:
@@ -535,7 +628,11 @@ class WireKafkaConsumer(KafkaWireClient):
         with self._lock:
             return self._buffer.pop(0) if self._buffer else None
 
-    def _fill(self, timeout: float) -> None:
+    def _fetch_pass(self, timeout: float):
+        """One Fetch round over every assigned partition; yields
+        (topic, partition, requested_pos, records_bytes) per partition
+        with data. Shared by the decoded poll path (``_fill``) and the
+        raw-ingest path (``fetch_raw``)."""
         if not self._meta_loaded:
             self._refresh_metadata()
         deadline = time.time() + max(timeout, 0.0)
@@ -578,25 +675,58 @@ class WireKafkaConsumer(KafkaWireClient):
                             "kafka fetch error %d on %s/%d", err, tname, pidx
                         )
                         continue
-                    recs, next_off = decode_record_batches(records)
-                    msgs = []
-                    for offset, _ts, value in recs:
-                        if offset < self._positions[(tname, pidx)]:
-                            continue  # batch may start before request pos
-                        msgs.append(WireMessage(tname, pidx, offset, value))
-                    if msgs:
-                        with self._lock:
-                            self._buffer.extend(msgs)
-                    # advance past EVERYTHING the fetch covered —
-                    # including skipped control batches, which would
-                    # otherwise be refetched in a hot loop forever
-                    pos_key = (tname, pidx)
-                    new_pos = max(
-                        next_off,
-                        (msgs[-1].offset() + 1) if msgs else -1,
-                    )
-                    if new_pos > self._positions[pos_key]:
-                        self._positions[pos_key] = new_pos
+                    yield tname, pidx, self._positions[(tname, pidx)], records
+
+    def _fill(self, timeout: float) -> None:
+        for tname, pidx, pos, records in self._fetch_pass(timeout):
+            recs, next_off = decode_record_batches(
+                records, stats=self.ingest_stats
+            )
+            msgs = []
+            for offset, _ts, value in recs:
+                if offset < pos:
+                    continue  # batch may start before request pos
+                msgs.append(WireMessage(tname, pidx, offset, value))
+            if msgs:
+                with self._lock:
+                    self._buffer.extend(msgs)
+            # advance past EVERYTHING the fetch covered — including
+            # skipped control batches, which would otherwise be
+            # refetched in a hot loop forever
+            pos_key = (tname, pidx)
+            new_pos = max(
+                next_off,
+                (msgs[-1].offset() + 1) if msgs else -1,
+            )
+            if new_pos > self._positions[pos_key]:
+                self._positions[pos_key] = new_pos
+
+    def fetch_raw(self, timeout: float = 0.05):
+        """The binary fast path's fetch: one Fetch round returning RAW
+        v2 record-batch bytes per partition —
+        ``[(topic, partition, requested_pos, records_bytes,
+        next_offset), ...]`` — with positions advanced from the frame
+        headers alone (``iter_batch_spans``; no record decode, no
+        Python object per record). Compressed batches surface as the
+        typed error at DECODE time, and corrupt batches are skipped +
+        counted there too — this layer only frames and advances.
+
+        A batch may start before ``requested_pos`` (Kafka serves whole
+        batches); the decoder will then re-emit rows below the position
+        — duplicates, never loss (the at-least-once contract every
+        source here honors)."""
+        out = []
+        for tname, pidx, pos, records in self._fetch_pass(timeout):
+            next_off = -1
+            for span in iter_batch_spans(records):
+                next_off = max(next_off, span["next_offset"])
+            if not records:
+                continue
+            out.append((tname, pidx, pos, records, next_off))
+            pos_key = (tname, pidx)
+            if next_off > self._positions[pos_key]:
+                self._positions[pos_key] = next_off
+        return out
 
 
 class WireKafkaProducer(KafkaWireClient):

@@ -300,6 +300,21 @@ def pack_raw(
     )
 
 
+def pack_from_matrix(
+    matrix: np.ndarray, layout: Tuple[Tuple[str, str], ...],
+    to_device: bool = True,
+) -> PackedRaw:
+    """PackedRaw over an ALREADY-packed matrix — the zero-copy sibling
+    of ``pack_raw`` for the native decoder's pooled ingest buffers,
+    which are written in the transfer layout to begin with. On the CPU
+    backend ``jnp.asarray`` of the 64-byte-aligned pool matrix is a
+    zero-copy view, which is exactly why the pool may only reuse a
+    matrix after its batch has landed (PendingBatch slot release)."""
+    return PackedRaw(
+        jnp.asarray(matrix) if to_device else matrix, tuple(layout)
+    )
+
+
 def build_step_fn(
     ts_col: Optional[str],
     windows: Dict[str, Tuple[str, float]],
@@ -636,6 +651,20 @@ class FlowProcessor:
                 f"process.pipeline.depth must be >= 1, got {depth}"
             )
         self.pipeline_depth = depth
+        # ingest decode sharding (datax.job.process.ingest.*): the
+        # conf'd shard count the native decoder fans each payload
+        # across (designer knob jobDecoderThreads -> generation;
+        # DATAX_DECODER_THREADS stays the operator override). None =
+        # engine default (cap 4 — ingest shares the host with the
+        # engine loop and sinks).
+        ing_conf = process_conf.get_sub_dictionary("ingest.")
+        decoder_threads = ing_conf.get_int_option("decoderthreads")
+        if decoder_threads is not None and decoder_threads < 1:
+            raise EngineException(
+                f"process.ingest.decoderthreads must be >= 1, got "
+                f"{decoder_threads}"
+            )
+        self.decoder_threads = decoder_threads
         self.sized_transfer = (
             (pipe_conf.get_or_else("sizedtransfer", "true") or "").lower()
             != "false"
@@ -1172,6 +1201,21 @@ class FlowProcessor:
         # of ingest_stats can't race the flood signal)
         self.malformed_rows_total = 0
         self._native_decoders: Dict[str, object] = {}
+        # ingest decode fast path state: per-source pools of persistent
+        # 64-byte-aligned packed H2D matrices (decoder shards write
+        # straight into them; slots release when their batch lands),
+        # the schema-column -> matrix-row maps, and the decode gauges
+        # (Decode_Shards / Decode_RowsPerSec / Decode_BufferReuse_Count)
+        self._ingest_pools: Dict[str, object] = {}
+        self._ingest_col_rows: Dict[str, List[int]] = {}
+        self._decode_shards: Optional[int] = None
+        self._decode_rows_per_sec: Optional[float] = None
+        # which decode engine served the last encode_json_bytes call:
+        # "native-sharded" (packed pool path) / "native-mt" (row-layout
+        # native, e.g. under a mesh) / "python-fallback" — bench.py
+        # records it in BENCH_CONTEXT and the regression gate refuses
+        # cross-path comparisons
+        self.last_decoder_path: Optional[str] = None
 
     def reset_state(self) -> None:
         """Zero device state (rings, slot counter, time base; state
@@ -1506,67 +1550,59 @@ class FlowProcessor:
         source: Optional[str] = None,
         packed: Optional[bool] = None,
         to_device: bool = True,
+        fmt: str = "jsonl",
     ) -> Union[TableData, "PackedRaw"]:
-        """Native ingest hot path: newline-delimited JSON bytes decoded by
-        the C++ decoder (native/decoder.cpp) straight into columnar
-        buffers — the from_json role at CommonProcessorFactory.scala:90-103
+        """Native ingest hot path: raw wire bytes decoded by the C++
+        decoder (native/decoder.cpp) straight into columnar buffers —
+        the from_json role at CommonProcessorFactory.scala:90-103
         without any per-event Python objects. Falls back to the Python
         row encoder if the native library is unavailable.
 
+        ``fmt``: ``"jsonl"`` (newline-delimited JSON — socket/file
+        sources) or ``"kafka-v2"`` (whole Kafka message-format-v2
+        record batches from ``KafkaSource.poll_raw`` — the native
+        walker verifies CRC-32C per batch, skips+counts corrupt
+        batches, rejects compressed ones with a typed error, and feeds
+        record values to the JSON column decoder in the same call).
+
         ``packed`` (default: auto — on for single-chip, off under a
-        mesh, whose row shardings expect [capacity] leaves): ship the
-        batch as ONE stacked host->device transfer (PackedRaw) instead
-        of one per column."""
+        mesh, whose row shardings expect [capacity] leaves): decoder
+        shards write directly into a persistent 64-byte-aligned pooled
+        matrix in the single-transfer PackedRaw layout — zero per-row
+        Python objects, zero per-call column allocations, no pack
+        copy. The matrix is reused only after its batch lands
+        (PendingBatch releases the slot), double-buffering the pool
+        against the pipelined in-flight window."""
         from ..native import native_available
 
         spec = self._spec(source)
         if packed is None:
             packed = self.mesh is None
         if not native_available():
-            import json as _json
-
-            rows = []
-            malformed = 0
-            for ln in data.splitlines():
-                if not ln.strip():
-                    continue
-                try:
-                    rows.append(_json.loads(ln))
-                except ValueError:
-                    malformed += 1  # skip malformed lines, but count
-                    continue        # them: the pilot's flood signal
-                if len(rows) >= spec.capacity:
-                    break
-            if malformed:
-                self.ingest_stats["malformed_rows"] = (
-                    self.ingest_stats.get("malformed_rows", 0) + malformed
-                )
-                self.malformed_rows_total += malformed
-            return self.encode_rows(rows, base_ms, source=spec.name)
+            self.last_decoder_path = "python-fallback"
+            return self._encode_json_python(data, base_ms, spec, fmt)
 
         decoder = self._native_decoders.get(spec.name)
         if decoder is None:
             from ..native import NativeDecoder
 
-            decoder = NativeDecoder(spec.schema, self.dictionary)
-            self._native_decoders[spec.name] = decoder
-        arrays, valid, rows, _consumed = decoder.decode(data, spec.capacity)
-        # malformed lines in the consumed range = newline count minus
-        # decoded rows (the decoder zero-gaps them); feeds the
-        # Input_malformed_rows_Count metric and the pilot flood signal
-        consumed_blob = data[:_consumed] if _consumed else data
-        # allocation-free line count (bytes.count is C): blank lines
-        # are rare enough that miscounting one as malformed can't move
-        # the pilot's 30% flood threshold
-        lines_seen = consumed_blob.count(b"\n")
-        if consumed_blob and not consumed_blob.endswith(b"\n"):
-            lines_seen += 1
-        malformed = max(0, lines_seen - int(rows))
-        if malformed:
-            self.ingest_stats["malformed_rows"] = (
-                self.ingest_stats.get("malformed_rows", 0) + malformed
+            decoder = NativeDecoder(
+                spec.schema, self.dictionary, threads=self.decoder_threads
             )
-            self.malformed_rows_total += malformed
+            self._native_decoders[spec.name] = decoder
+
+        if packed:
+            return self._encode_packed_native(
+                decoder, data, base_ms, spec, fmt, to_device
+            )
+
+        # row-layout native path (mesh shardings want [capacity] leaves)
+        self.last_decoder_path = "native-mt"
+        if fmt == "kafka-v2":
+            data = self._kafka_values_to_lines(data)
+        arrays, valid, rows, _consumed = decoder.decode(data, spec.capacity)
+        self._decode_shards = decoder.last_shards
+        self._count_jsonl_malformed(data, _consumed, rows)
         if decoder.last_bad_timestamps:
             self.ingest_stats["bad_timestamps"] = (
                 self.ingest_stats.get("bad_timestamps", 0)
@@ -1607,12 +1643,183 @@ class FlowProcessor:
             valid = self._filter_unowned(
                 np_cols.get(self.state_partition_key), valid, spec
             )
-        if packed:
-            return pack_raw(np_cols, valid, to_device=to_device)
         return TableData(
             {c: jnp.asarray(a) for c, a in np_cols.items()},
             jnp.asarray(valid),
         )
+
+    # -- ingest fast-path helpers -----------------------------------------
+    def _count_jsonl_malformed(self, data: bytes, consumed: int,
+                               rows: int) -> None:
+        """Malformed lines in the consumed range = newline count minus
+        decoded rows (the decoder zero-gaps them); feeds the
+        Input_malformed_rows_Count metric and the pilot flood signal.
+        Allocation-free line count (bytes.count is C): blank lines are
+        rare enough that miscounting one as malformed can't move the
+        pilot's 30% flood threshold."""
+        consumed_blob = data[:consumed] if consumed else data
+        lines_seen = consumed_blob.count(b"\n")
+        if consumed_blob and not consumed_blob.endswith(b"\n"):
+            lines_seen += 1
+        malformed = max(0, lines_seen - int(rows))
+        if malformed:
+            self.ingest_stats["malformed_rows"] = (
+                self.ingest_stats.get("malformed_rows", 0) + malformed
+            )
+            self.malformed_rows_total += malformed
+
+    def _count_ingest(self, key: str, n: int, malformed: bool = False) -> None:
+        if not n:
+            return
+        self.ingest_stats[key] = self.ingest_stats.get(key, 0) + n
+        if malformed:
+            self.malformed_rows_total += n
+
+    def _kafka_values_to_lines(self, data: bytes) -> bytes:
+        """Python record-batch walk for the row-layout/fallback paths:
+        extract record values (CRC verified, corrupt batches counted,
+        compressed rejected typed) and hand them to the line decoder.
+        Well-formed JSON never contains a raw newline, so the join is
+        loss-free; a malformed value containing one just counts as
+        malformed twice."""
+        from .kafka_wire import decode_record_batches
+
+        stats: Dict[str, int] = {}
+        recs, _next = decode_record_batches(data, stats=stats)
+        self._count_ingest("CorruptBatch", stats.get("corrupt_batches", 0))
+        return b"\n".join(v for _o, _ts, v in recs) + (b"\n" if recs else b"")
+
+    def _encode_json_python(
+        self, data: bytes, base_ms: int, spec: SourceSpec, fmt: str,
+    ) -> TableData:
+        """No native library: per-row Python decode (json.loads into the
+        row encoder), with the same malformed/corrupt accounting as the
+        fast path so the pilot's flood signal never goes blind."""
+        import json as _json
+
+        if fmt == "kafka-v2":
+            from .kafka_wire import decode_record_batches
+
+            stats: Dict[str, int] = {}
+            recs, _next = decode_record_batches(data, stats=stats)
+            self._count_ingest(
+                "CorruptBatch", stats.get("corrupt_batches", 0)
+            )
+            lines: List[bytes] = [v for _o, _ts, v in recs]
+        else:
+            lines = data.splitlines()
+        rows = []
+        malformed = 0
+        for ln in lines:
+            if not ln.strip():
+                # a blank jsonl line is framing noise; an EMPTY Kafka
+                # record value is a real record with no event — count
+                # it malformed like the native walker does
+                if fmt == "kafka-v2":
+                    malformed += 1
+                continue
+            try:
+                rows.append(_json.loads(ln))
+            except ValueError:
+                malformed += 1  # skip malformed lines, but count
+                continue        # them: the pilot's flood signal
+            if len(rows) >= spec.capacity:
+                break
+        self._count_ingest("malformed_rows", malformed, malformed=True)
+        return self.encode_rows(rows, base_ms, source=spec.name)
+
+    def _encode_packed_native(
+        self, decoder, data: bytes, base_ms: int, spec: SourceSpec,
+        fmt: str, to_device: bool,
+    ) -> "PackedRaw":
+        """The allocation-free hot path: acquire a pooled, persistent,
+        64-byte-aligned matrix already laid out as the packed H2D
+        transfer and let the decoder shards write straight into it.
+        The returned PackedRaw carries its pool slot; dispatch hands it
+        to the PendingBatch, which releases it when the batch lands (or
+        abandons) — never while the device step may still be reading
+        the zero-copied buffer."""
+        from ..native import PackedBufferPool
+
+        layout = packed_raw_layout(spec.raw_schema.types)
+        names = [c for c, _k in layout]
+        n_rows = len(layout) + 1
+        cap = spec.capacity
+        pool = self._ingest_pools.get(spec.name)
+        if (
+            pool is None or pool.n_rows != n_rows or pool.capacity != cap
+        ):
+            pool = PackedBufferPool(n_rows, cap)
+            self._ingest_pools[spec.name] = pool
+        col_rows = self._ingest_col_rows.get(spec.name)
+        if col_rows is None:
+            index = {c: i for i, c in enumerate(names)}
+            col_rows = [index[c.name] for c in spec.schema.columns]
+            self._ingest_col_rows[spec.name] = col_rows
+        valid_row = len(layout)
+        mat = pool.acquire()
+        t0 = time.perf_counter()
+        try:
+            if fmt == "kafka-v2":
+                rows, kstats = decoder.decode_kafka_packed(
+                    data, mat, col_rows, valid_row, base_ms, max_rows=cap
+                )
+                self._count_ingest(
+                    "malformed_rows", kstats["malformed"], malformed=True
+                )
+                self._count_ingest("CorruptBatch", kstats["corrupt_batches"])
+                # records that arrived without a row slot are LOST data
+                # (a producer batch larger than the flow capacity) —
+                # loud, never silent
+                self._count_ingest(
+                    "kafka_overflow_rows", kstats["overflow_dropped"]
+                )
+            else:
+                rows, consumed = decoder.decode_packed(
+                    data, mat, col_rows, valid_row, base_ms, max_rows=cap
+                )
+                self._count_jsonl_malformed(data, consumed, rows)
+        except Exception:
+            pool.release(mat)
+            raise
+        dt = time.perf_counter() - t0
+        self.last_decoder_path = "native-sharded"
+        self._decode_shards = decoder.last_shards
+        if dt > 0 and rows:
+            self._decode_rows_per_sec = rows / dt
+        if decoder.last_bad_timestamps:
+            self.ingest_stats["bad_timestamps"] = (
+                self.ingest_stats.get("bad_timestamps", 0)
+                + decoder.last_bad_timestamps
+            )
+        # rows the decoder doesn't own (Properties/SystemProperties):
+        # the pool hands back dirty matrices, so (re)fill them per call
+        # — one vectorized fill per extra row, not a fresh allocation
+        schema_rows = set(col_rows)
+        for i, cname in enumerate(names):
+            if i in schema_rows:
+                continue
+            if (
+                cname == ColumnName.RawPropertiesColumn
+                and self.properties_enabled
+            ):
+                mat[i].fill(self._properties_id(base_ms))
+            else:
+                mat[i].fill(0)
+        if self.state_filter_ingest:
+            key = self.state_partition_key
+            kv = None
+            if key in names:
+                krow = mat[names.index(key)]
+                kind = dict(layout).get(key)
+                kv = krow.view(np.float32) if kind == "f32" else krow
+            new_valid = self._filter_unowned(
+                kv, mat[valid_row] != 0, spec
+            )
+            mat[valid_row] = new_valid.astype(np.int32)
+        pr = pack_from_matrix(mat, layout, to_device=to_device)
+        pr._ingest_pool = (pool, mat)
+        return pr
 
     def encode_columns(
         self, np_cols: Dict[str, np.ndarray], n: int,
@@ -1782,19 +1989,35 @@ class FlowProcessor:
         # (so they cover every id the batch can contain), cached until the
         # dictionary grows; growth past table capacity retraces the step
         aux = self.aux_tables.tables()
+        # pooled ingest buffers riding this batch's raw inputs: owned by
+        # the PendingBatch until its landing (or abandon) — the step
+        # zero-copies them on the CPU backend, so early reuse would be
+        # a read of freed-for-overwrite memory
+        ingest_buffers = [
+            r._ingest_pool for r in raw.values()
+            if getattr(r, "_ingest_pool", None) is not None
+        ]
         # child span of the host's "dispatch" when a batch trace is
         # active (obs/tracing.py); a no-op under bench/LiveQuery drivers
-        with _trace_span("device-enqueue"), self._debug_guard(), \
-                self._device_state_lock:
-            out_datasets, new_rings, new_state, counts_vec = self._step(
-                raw, self.window_buffers, self.state_data, refdata_tables,
-                base_s, now_rel_ms, counter, jnp.asarray(delta_ms, jnp.int32),
-                aux,
-            )
-            # carry device state forward without materializing — the next
-            # dispatch may consume these handles before this batch collects
-            self.window_buffers = new_rings
-            self.state_data = new_state
+        try:
+            with _trace_span("device-enqueue"), self._debug_guard(), \
+                    self._device_state_lock:
+                out_datasets, new_rings, new_state, counts_vec = self._step(
+                    raw, self.window_buffers, self.state_data, refdata_tables,
+                    base_s, now_rel_ms, counter,
+                    jnp.asarray(delta_ms, jnp.int32),
+                    aux,
+                )
+                # carry device state forward without materializing — the
+                # next dispatch may consume these handles before this
+                # batch collects
+                self.window_buffers = new_rings
+                self.state_data = new_state
+        except Exception:
+            # the step never launched: the pool slots are safe to reuse
+            for pool, mat in ingest_buffers:
+                pool.release(mat)
+            raise
         # sized output transfer: shrink each output's D2H copy to its
         # adaptive capacity (power-of-two bucket over the count EWMA),
         # written into the output's donated A/B transfer slot so the
@@ -1820,6 +2043,9 @@ class FlowProcessor:
             fetch_tables=fetch_tables,
             fetch_caps=fetch_caps,
         )
+        # this batch's pooled ingest matrices: released by the handle
+        # when the batch lands/abandons, never before the step is done
+        handle._ingest_buffers = ingest_buffers
         # each staged slot is owned by THIS batch until its transfer
         # lands: record the handle's landed-event so the dispatch that
         # next rotates onto the slot knows whether donation is safe
@@ -2389,11 +2615,29 @@ class PendingBatch:
         # the batch is abandoned): the signal slot rotation checks
         # before donating this batch's transfer buffers to a new pack
         self._landed = threading.Event()
+        # pooled ingest matrices this batch's raw inputs live in
+        # (set by dispatch_batch); released exactly once, at landing or
+        # abandon — the decode buffer pool's reuse gate
+        self._ingest_buffers: List = []
+
+    def _release_ingest(self) -> None:
+        bufs, self._ingest_buffers = self._ingest_buffers, []
+        for pool, mat in bufs:
+            pool.release(mat)
 
     def abandon(self) -> None:
         """Mark a batch that will never be collected (window requeued
         after a failure): releases its transfer slots for donation and
         unblocks anyone coordinating on the landing."""
+        if self._ingest_buffers:
+            # the step may still be consuming the zero-copied ingest
+            # matrices; wait for device completion before the pool may
+            # hand them to a new decode (failure path — rare, cheap)
+            try:
+                jax.block_until_ready(self.counts_vec)
+            except Exception:  # noqa: BLE001 — a failed step frees its inputs
+                pass
+        self._release_ingest()
         self._landed.set()
 
     def start_fetch(self) -> None:
@@ -2570,7 +2814,10 @@ class PendingBatch:
                 self._transferred_rows = sum(dataset_counts.values())
         finally:
             # host copies landed (or the fetch failed): this batch's
-            # transfer slots are safe to donate to a future pack
+            # transfer slots are safe to donate to a future pack, and
+            # its pooled ingest matrices (fully consumed by the step,
+            # which completed at the counts sync) return to the pool
+            self._release_ingest()
             self._landed.set()
 
         datasets: Dict[str, List[dict]] = {}
@@ -2615,6 +2862,20 @@ class PendingBatch:
                 if v:
                     metrics[f"Input_{k}_Count"] = float(v)
             proc.ingest_stats.clear()
+        # ingest decode fast-path gauges (native/decoder.cpp): the
+        # shard count in effect, the last measured decode rate, and
+        # buffer-pool reuses since the last collect — the runtime face
+        # of the BENCH decoder_rows_per_sec / shard-curve numbers
+        if proc._decode_shards is not None:
+            metrics["Decode_Shards"] = float(proc._decode_shards)
+        if proc._decode_rows_per_sec is not None:
+            metrics["Decode_RowsPerSec"] = float(proc._decode_rows_per_sec)
+        if proc._ingest_pools:
+            reuse = sum(
+                p.take_reuse_count() for p in proc._ingest_pools.values()
+            )
+            if reuse:
+                metrics["Decode_BufferReuse_Count"] = float(reuse)
         if proc.dictionary.overflow_count:
             metrics["Input_string_dictionary_overflow_Count"] = float(
                 proc.dictionary.overflow_count

@@ -423,6 +423,10 @@ class KafkaSource(StreamingSource):
     values must be JSON event bodies.
     """
 
+    # wire format the raw fast path delivers: whole Kafka v2 record
+    # batches, decoded natively by encode_json_bytes(fmt="kafka-v2")
+    raw_format = "kafka-v2"
+
     def __init__(
         self,
         brokers: str,
@@ -441,8 +445,17 @@ class KafkaSource(StreamingSource):
         self._fifo = UnackedFifo()
         # checkpointed positions to seek once partitions are assigned
         self._pending_seek: Dict[Tuple[str, int], int] = {}
+        # malformed record values dropped by the Python poll paths —
+        # drained by the host into ingest_stats/malformed_rows_total so
+        # the pilot's flood signal covers Kafka flows too
+        self._stats: Dict[str, int] = {}
+        # fetched-but-undelivered raw batch spans (binary fast path):
+        # (topic, partition, frame bytes, record budget, from, until)
+        self._raw_pending: List[Tuple[str, int, bytes, int, int, int]] = []
         if consumer is not None:
             self._consumer = consumer  # injected for tests
+            if hasattr(consumer, "fetch_raw"):
+                self.poll_raw = self._poll_raw
         else:
             try:
                 from confluent_kafka import Consumer  # type: ignore
@@ -462,6 +475,12 @@ class KafkaSource(StreamingSource):
                         password=password,
                     )
                     self._flavor = "wire"
+                    # the wire client serves raw v2 record-batch bytes:
+                    # expose poll_raw so StreamingHost routes this
+                    # source through the native binary fast path
+                    # (encode_json_bytes fmt="kafka-v2") like every
+                    # other raw source
+                    self.poll_raw = self._poll_raw
                     return
                 kp_kwargs = {}
                 if security:
@@ -575,6 +594,30 @@ class KafkaSource(StreamingSource):
                     topic, partition, seq, e,
                 )
 
+    def _count_malformed(self, n: int = 1) -> None:
+        """A record value that isn't JSON is dropped but COUNTED — the
+        host drains this into ``ingest_stats["malformed_rows"]`` /
+        ``malformed_rows_total``, so the pilot's malformed-flood signal
+        (and the Input_malformed_rows_Count metric) see Kafka garbage
+        exactly like socket-line garbage instead of being blind to it."""
+        self._stats["malformed_rows"] = (
+            self._stats.get("malformed_rows", 0) + n
+        )
+
+    def take_ingest_stats(self) -> Dict[str, int]:
+        """Drain ingest-side counters accumulated since the last take:
+        this source's malformed record values plus any protocol-layer
+        counters the wire consumer kept (CRC-skipped corrupt batches)."""
+        out, self._stats = self._stats, {}
+        wire_stats = getattr(self._consumer, "ingest_stats", None)
+        if wire_stats:
+            for k, v in wire_stats.items():
+                if k == "corrupt_batches":
+                    k = "CorruptBatch"
+                out[k] = out.get(k, 0) + v
+            wire_stats.clear()
+        return out
+
     def _consume(self, max_events: int) -> Tuple[List[dict], Offsets]:
         self._apply_pending_seeks()
         rows: List[dict] = []
@@ -588,7 +631,10 @@ class KafkaSource(StreamingSource):
                     break
                 for tp, msgs in batch.items():
                     for m in msgs:
-                        rows.append(json.loads(m.value))
+                        try:
+                            rows.append(json.loads(m.value))
+                        except ValueError:
+                            self._count_malformed()
                         key = (tp.topic, tp.partition)
                         frm = offsets.get(key, (m.offset, m.offset))[0]
                         offsets[key] = (frm, m.offset + 1)
@@ -603,11 +649,71 @@ class KafkaSource(StreamingSource):
                 # spinning on instantly-returned error events
                 logger.warning("kafka message error: %s", msg.error())
                 break
-            rows.append(json.loads(msg.value()))
+            try:
+                rows.append(json.loads(msg.value()))
+            except ValueError:
+                self._count_malformed()
             key = (msg.topic(), msg.partition())
             frm = offsets.get(key, (msg.offset(), msg.offset()))[0]
             offsets[key] = (frm, msg.offset() + 1)
         return rows, offsets
+
+    # -- the binary fast path ---------------------------------------------
+    def _consume_raw(self, max_events: int) -> Tuple[bytes, int, Offsets]:
+        """One raw delivery: whole v2 record-batch frames (concatenated
+        — exactly what ``decode_record_batches`` / the native walker
+        accept), budgeted to ~max_events records at BATCH granularity
+        so the decoder's row slots can't silently overflow. Leftover
+        batches stay queued for the next poll with their offset
+        ranges."""
+        self._apply_pending_seeks()
+        if not self._raw_pending:
+            from .kafka_wire import iter_batch_spans
+
+            for topic, partition, pos, records, next_off in (
+                self._consumer.fetch_raw(0.05)
+            ):
+                cur = pos
+                for span in iter_batch_spans(records):
+                    until = max(cur, span["next_offset"])
+                    self._raw_pending.append((
+                        topic, partition,
+                        records[span["start"]: span["end"]],
+                        max(0, int(span["record_count"])),
+                        cur, until,
+                    ))
+                    cur = until
+        parts: List[bytes] = []
+        offsets: Offsets = {}
+        total = 0
+        while self._raw_pending:
+            _t, _p, frame, count, frm, until = self._raw_pending[0]
+            if parts and total + count > max_events:
+                break  # batch granularity: never split a batch
+            self._raw_pending.pop(0)
+            parts.append(frame)
+            total += count
+            key = (_t, _p)
+            prev = offsets.get(key)
+            offsets[key] = (
+                (min(prev[0], frm), max(prev[1], until))
+                if prev else (frm, until)
+            )
+        return b"".join(parts), total, offsets
+
+    def _poll_raw(self, max_events: int) -> Tuple[bytes, int, Offsets]:
+        """Raw record-batch delivery for the native Kafka fast path
+        (bound to ``poll_raw`` when the consumer can serve raw bytes).
+        Same un-acked FIFO contract as every buffering source: ack()
+        releases + commits oldest-first, requeue_unacked() re-delivers
+        after a failed batch."""
+        requeued = self._fifo.next_redelivery()
+        if requeued is not None:
+            blob, n, offsets = requeued
+        else:
+            blob, n, offsets = self._consume_raw(max_events)
+        self._fifo.deliver((blob, n, offsets))
+        return blob, n, offsets
 
     def poll(self, max_events: int) -> Tuple[List[dict], Offsets]:
         """Polled batches join an un-acked FIFO (same contract as
@@ -625,7 +731,9 @@ class KafkaSource(StreamingSource):
     def ack(self) -> None:
         released = self._fifo.ack_oldest()
         if released is not None:
-            self._commit(released[1])
+            # fifo entries are (rows, offsets) from poll() or
+            # (blob, n, offsets) from poll_raw(): offsets ride last
+            self._commit(released[-1])
 
     def requeue_unacked(self) -> None:
         self._fifo.requeue_all()

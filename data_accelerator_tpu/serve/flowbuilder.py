@@ -72,6 +72,7 @@ DEFAULT_JOB_COMMON_TOKENS: Dict[str, str] = {
     "jobNumChips": "_S_{guiJobNumChips}",
     "jobBatchCapacity": "_S_{guiJobBatchCapacity}",
     "jobPipelineDepth": "_S_{guiJobPipelineDepth}",
+    "jobDecoderThreads": "_S_{guiJobDecoderThreads}",
     "jobObservabilityPort": "_S_{guiJobObservabilityPort}",
     "jobCompileJitCacheCap": "_S_{guiJobCompileJitCacheCap}",
     "processedSchemaPath": "_S_{processedSchemaPath}",

@@ -196,6 +196,12 @@ class RuntimeConfigGeneration:
             "guiJobPipelineDepth": str(
                 jobconf.get("jobPipelineDepth") or ""
             ),
+            # ingest decoder shard count (native/decoder.cpp sharded
+            # decode); empty = engine default (cap 4), env
+            # DATAX_DECODER_THREADS stays the operator override
+            "guiJobDecoderThreads": str(
+                jobconf.get("jobDecoderThreads") or ""
+            ),
             # host Prometheus/health port (0/empty = ephemeral); the
             # fleet analyzer's DX413 lint flags co-placed flows that
             # pin the same port
@@ -683,6 +689,9 @@ class RuntimeConfigGeneration:
             if jt.get("jobPipelineDepth"):
                 extra["datax.job.process.pipeline.depth"] = str(
                     jt.get("jobPipelineDepth"))
+            if jt.get("jobDecoderThreads"):
+                extra["datax.job.process.ingest.decoderthreads"] = str(
+                    jt.get("jobDecoderThreads"))
             if jt.get("jobObservabilityPort"):
                 extra["datax.job.process.observability.port"] = str(
                     jt.get("jobObservabilityPort"))

@@ -555,8 +555,10 @@ route("#/flow/", async (view, hash) => {
     gui.process.jobconfig = gui.process.jobconfig || {};
     pane.append(field(gui.process.jobconfig, "jobNumChips", "TPU chips", { ph: "1" }));
     pane.append(field(gui.process.jobconfig, "jobBatchCapacity", "Batch capacity (rows)", { ph: "65536" }));
+    pane.append(field(gui.process.jobconfig, "jobDecoderThreads", "Ingest decoder shards", { ph: "engine default" }));
     pane.append(h("div", { class: "muted" },
-      "capacity shards over the chip mesh; collectives ride ICI"));
+      "capacity shards over the chip mesh; collectives ride ICI; " +
+      "decoder shards fan the host-side ingest parse across cores"));
   } else if (tab === "schedule") {
     const list = h("div", {});
     const renderBatches = () => {

@@ -123,6 +123,28 @@ def test_kafka_ack_close():
     assert fc.closed
 
 
+def test_kafka_malformed_values_counted_not_fatal():
+    """Satellite: a record value that isn't JSON must not kill the
+    poll (it used to raise out of json.loads, poisoning the batch loop
+    into an infinite requeue) — it is dropped and COUNTED so the
+    host's ingest_stats/malformed_rows_total (and the pilot's flood
+    signal) see Kafka garbage."""
+    msgs = [
+        FakeMessage("t1", 0, 0, json.dumps({"a": 1}).encode()),
+        FakeMessage("t1", 0, 1, b"{definitely not json"),
+        FakeMessage("t1", 0, 2, json.dumps({"a": 3}).encode()),
+    ]
+    src = KafkaSource("b", ["t1"], consumer=FakeConsumer(msgs))
+    rows, offsets = src.poll(10)
+    assert [r["a"] for r in rows] == [1, 3]
+    # the bad record's offset still advances (it is consumed, not stuck)
+    assert offsets[("t1", 0)] == (0, 3)
+    stats = src.take_ingest_stats()
+    assert stats == {"malformed_rows": 1}
+    # drained: a second take is empty
+    assert src.take_ingest_stats() == {}
+
+
 def test_kafka_without_client_library_uses_wire_client(monkeypatch):
     """No client library installed -> the built-in wire-protocol client
     (runtime/kafka_wire.py) takes over instead of raising."""

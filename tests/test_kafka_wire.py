@@ -323,6 +323,75 @@ class TestKafkaSourceOverWire:
         assert offsets[("events", 1)] == (1, 2)
         assert len(rows) == 3
 
+    def test_streaming_host_routes_kafka_through_native_fast_path(
+        self, broker, tmp_path,
+    ):
+        """E2E tentpole: a StreamingHost over the wire KafkaSource
+        polls RAW record batches (poll_raw) and decodes them through
+        encode_json_bytes(fmt="kafka-v2") — the native packed path
+        when the library is built — landing every record in the sink
+        exactly once."""
+        from data_accelerator_tpu.core.config import SettingDictionary
+        from data_accelerator_tpu.native import native_available
+        from data_accelerator_tpu.runtime.host import StreamingHost
+        from data_accelerator_tpu.runtime.sinks import (
+            OutputDispatcher,
+            OutputOperator,
+        )
+
+        schema = json.dumps({"type": "struct", "fields": [
+            {"name": "tag", "type": "string", "nullable": False,
+             "metadata": {}},
+            {"name": "n", "type": "long", "nullable": False,
+             "metadata": {}},
+        ]})
+        t = tmp_path / "k.transform"
+        t.write_text(
+            "--DataXQuery--\n"
+            "Out = SELECT tag, n FROM DataXProcessedInput\n"
+        )
+        conf = SettingDictionary({
+            "datax.job.name": "KafkaE2E",
+            "datax.job.input.default.inputtype": "kafka",
+            "datax.job.input.default.kafka.bootstrapservers":
+                f"127.0.0.1:{broker.port}",
+            "datax.job.input.default.kafka.topics": "events",
+            "datax.job.input.default.blobschemafile": schema,
+            "datax.job.input.default.eventhub.maxrate": "100",
+            "datax.job.input.default.streaming.intervalinseconds": "1",
+            "datax.job.process.transform": str(t),
+            "datax.job.process.batchcapacity": "16",
+            "datax.job.output.Out.console.maxrows": "0",
+        })
+        host = StreamingHost(conf)
+        try:
+            src = host.source
+            assert src._flavor == "wire"
+            assert hasattr(src, "poll_raw")
+
+            class Rec:
+                kind = "rec"
+
+                def __init__(self):
+                    self.rows = []
+
+                def write(self, dataset, rows, batch_time_ms):
+                    self.rows.extend(rows)
+                    return len(rows)
+
+            sink = Rec()
+            host.dispatcher = OutputDispatcher(
+                {"Out": OutputOperator("Out", [sink])}, host.metric_logger
+            )
+            host.run_batch()
+            assert sorted(
+                (r["tag"], r["n"]) for r in sink.rows
+            ) == [("p0", 0), ("p0", 1), ("p0", 2), ("p1", 0), ("p1", 1)]
+            if native_available():
+                assert host.processor.last_decoder_path == "native-sharded"
+        finally:
+            host.stop()
+
     def test_make_source_eventhub_kafka_conf(self):
         from data_accelerator_tpu.core.config import SettingDictionary
         from data_accelerator_tpu.core.schema import Schema
@@ -347,23 +416,85 @@ class TestKafkaSourceOverWire:
         src.close()
 
 
+def _set_attributes(batch: bytes, attributes: int) -> bytes:
+    """Rewrite a batch's attributes field AND recompute its CRC-32C
+    (attributes live inside the CRC region — a bare flip would trip
+    the corruption check, which is its own test below)."""
+    from data_accelerator_tpu.runtime.kafka_wire import _crc32c
+
+    b = bytearray(batch)
+    b[21:23] = struct.pack(">h", attributes)
+    b[17:21] = struct.pack(">I", _crc32c(bytes(b[21:])))
+    return bytes(b)
+
+
 def test_control_batches_skipped():
     """Transaction markers (control batches, attributes bit 5) are
     metadata, not data — they must not surface as messages."""
     from data_accelerator_tpu.runtime.kafka_wire import decode_record_batches
 
     data_batch = encode_record_batch(0, [b'{"n":1}'])
-    marker = bytearray(encode_record_batch(1, [b"\x00\x00\x00\x01"]))
-    # set isControl (bit 5) in attributes at offset 21 (8 base_offset +
-    # 4 len + 4 epoch + 1 magic + 4 crc)
-    marker[21:23] = struct.pack(">h", 0x20)
-    records, next_off = decode_record_batches(
-        bytes(data_batch) + bytes(marker)
+    marker = _set_attributes(
+        encode_record_batch(1, [b"\x00\x00\x00\x01"]), 0x20
     )
+    records, next_off = decode_record_batches(bytes(data_batch) + marker)
     assert [(o, v) for o, _ts, v in records] == [(0, b'{"n":1}')]
     # the position must advance PAST the skipped marker, or a marker at
     # the log tail would be refetched in a hot loop forever
     assert next_off == 2
+
+
+def test_corrupt_batch_skipped_and_counted():
+    """Satellite: a batch whose CRC-32C does not verify is skipped
+    WHOLE and counted — its fields are never trusted (a bit flip in
+    the length/count region would otherwise mis-parse every later
+    batch into garbage rows). The position advances only past the
+    corrupt frame."""
+    from data_accelerator_tpu.runtime.kafka_wire import decode_record_batches
+
+    good = encode_record_batch(0, [b'{"n":1}', b'{"n":2}'])
+    bad = bytearray(encode_record_batch(2, [b'{"n":3}']))
+    bad[70 % len(bad)] ^= 0xFF  # flip a byte inside the CRC region
+    good2 = encode_record_batch(3, [b'{"n":4}'])
+    stats = {}
+    records, next_off = decode_record_batches(
+        good + bytes(bad) + good2, stats=stats
+    )
+    assert [json.loads(v)["n"] for _o, _ts, v in records] == [1, 2, 4]
+    assert stats["corrupt_batches"] == 1
+    assert next_off == 4
+
+
+def test_compressed_error_names_codec():
+    from data_accelerator_tpu.runtime.kafka_wire import (
+        UnsupportedCodecError,
+        decode_record_batches,
+    )
+
+    batch = _set_attributes(encode_record_batch(0, [b'{"n":1}']), 2)
+    with pytest.raises(UnsupportedCodecError, match="snappy") as ei:
+        decode_record_batches(batch)
+    assert ei.value.codec == "snappy"
+
+
+def test_wire_fetch_raw_serves_record_batches(broker):
+    """The binary fast path's fetch surface: raw v2 record-batch bytes
+    per partition with positions advanced from the frame headers —
+    and the bytes round-trip through the Python walker."""
+    from data_accelerator_tpu.runtime.kafka_wire import decode_record_batches
+
+    c = WireKafkaConsumer(f"127.0.0.1:{broker.port}", ["events"])
+    got = c.fetch_raw(0.2)
+    by_part = {(t, p): (pos, records, next_off)
+               for t, p, pos, records, next_off in got}
+    assert set(by_part) == {("events", 0), ("events", 1)}
+    pos0, records0, next0 = by_part[("events", 0)]
+    assert pos0 == 0 and next0 == 3
+    recs, _n = decode_record_batches(records0)
+    assert [json.loads(v)["n"] for _o, _ts, v in recs] == [0, 1, 2]
+    # positions advanced: a second raw fetch returns nothing new
+    assert c.fetch_raw(0.2) == []
+    c.close()
 
 
 class TestWireProducer:

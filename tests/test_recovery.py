@@ -472,6 +472,74 @@ def test_background_landing_failure_drains_and_requeues(tmp_path, depth):
         host.stop()
 
 
+@pytest.mark.parametrize("depth", [1, 2, 4])
+def test_decode_buffer_pool_safe_under_pipelined_window(tmp_path, depth):
+    """Satellite: the pooled ingest matrices under decode-ahead at
+    depths 1/2/4 with failure-requeue. The pool may hand a matrix to a
+    new decode ONLY after its owning batch released it (landed or
+    abandoned post-step) — never while the batch is in flight, where
+    the device step zero-copies the buffer. Asserted structurally (no
+    matrix is double-acquired while outstanding) and end-to-end (after
+    a poisoned-sink failure plus requeue, every event lands exactly
+    once with correct VALUES — a clobbered in-flight buffer would
+    corrupt rows, not just ordering)."""
+    from data_accelerator_tpu.native import native_available
+
+    if not native_available():
+        pytest.skip("native decoder unavailable")
+    host, src, sink = _depth_host(tmp_path, depth)
+    try:
+        # instrument every pool the processor creates: acquire must
+        # never return a matrix that is still owned by an un-released
+        # batch
+        outstanding = set()
+        violations = []
+        orig_encode = host.processor._encode_packed_native
+
+        def spy_encode(decoder, data, base_ms, spec, fmt, to_device):
+            pr = orig_encode(decoder, data, base_ms, spec, fmt, to_device)
+            pool, mat = pr._ingest_pool
+            if id(mat) in outstanding:
+                violations.append(id(mat))
+            outstanding.add(id(mat))
+            orig_release = pool.release
+
+            def tracked_release(m, _orig=orig_release):
+                outstanding.discard(id(m))
+                _orig(m)
+
+            pool.release = tracked_release
+            return pr
+
+        host.processor._encode_packed_native = spy_encode
+
+        _feed_socket(src, 16)  # batches B1(k 0-3) .. B4(k 12-15)
+        sink.poison_k = 9  # B3 fails at the sink mid-window
+        with pytest.raises(RuntimeError, match="poisoned"):
+            host.run_pipelined(max_batches=4)
+        src.requeue_unacked()
+        sink.poison_k = None
+        host.run_pipelined(max_batches=4)
+
+        assert not violations, (
+            "ingest pool handed out a matrix still owned by an "
+            "in-flight batch"
+        )
+        # exactly-once with intact VALUES through the reused buffers
+        all_ks = [k for _t, ks in sink.batches for k in ks]
+        assert all_ks == list(range(16))
+        # the pool genuinely reused matrices, bounded by the window
+        # (decode-ahead + pending + landing backlog), NOT one fresh
+        # allocation for each of the 8 decodes across the two runs
+        pools = host.processor._ingest_pools.values()
+        assert sum(p.reuse_count for p in pools) > 0
+        assert all(p.alloc_count <= depth + 4 for p in pools)
+        # nothing left un-released once every batch landed
+        assert not outstanding
+    finally:
+        host.stop()
+
+
 def test_udf_refresh_mid_window_uses_snapshotted_pipeline(tmp_path):
     """A UDF on_interval refresh (re-trace) while earlier batches are
     still in flight: each PendingBatch decodes against the
