@@ -728,6 +728,142 @@ def bench_pilot_overhead(iters=2000):
     }
 
 
+def bench_livequery(seconds=None, tenants=8, sessions_per_tenant=4,
+                    arrival_rate=None):
+    """LiveQuery serving-plane block: kernel QPS + p99 interactive
+    latency under a simulated multi-tenant OPEN-LOOP load — executes
+    arrive on a fixed schedule regardless of completion (the
+    many-users-refreshing-dashboards shape), so queueing delay shows in
+    the latency numbers instead of being absorbed by a closed loop.
+    All sessions share one flow + query, the serving plane's dominant
+    case: the coalescer merges them per compile signature, so the block
+    also records the fan-in and proves the compile surface stayed at
+    ONE entry while tenant count and QPS scaled. A second, throttled
+    service then drives a tenant past its QPS quota and asserts the
+    rejected calls consumed ZERO device dispatches (the
+    no-dispatch-on-reject contract the REST 429 path relies on)."""
+    import threading as _threading
+
+    from data_accelerator_tpu.lq.service import LQ_EXEC_STAGE, LQ_FLOW, LiveQueryService
+    from data_accelerator_tpu.lq.session import AdmissionRejected
+
+    seconds = float(
+        seconds if seconds is not None
+        else os.environ.get("BENCH_LQ_SECONDS", "1.5")
+    )
+    arrival_rate = float(
+        arrival_rate if arrival_rate is not None
+        else os.environ.get("BENCH_LQ_RATE", "500")
+    )
+    schema = json.dumps({"type": "struct", "fields": [
+        {"name": "deviceId", "type": "long", "nullable": False,
+         "metadata": {}},
+        {"name": "temperature", "type": "double", "nullable": False,
+         "metadata": {}},
+        {"name": "eventTimeStamp", "type": "timestamp", "nullable": False,
+         "metadata": {}},
+    ]})
+    base = 1_700_000_000_000
+    rows = [
+        {"deviceId": i % 7, "temperature": 20.0 + (i % 13),
+         "eventTimeStamp": base + i}
+        for i in range(60)  # pads into the 64-row pow2 bucket
+    ]
+    query = (
+        "Agg = SELECT deviceId, COUNT(*) AS Cnt, MAX(temperature) AS "
+        "MaxTemp FROM DataXProcessedInput GROUP BY deviceId"
+    )
+    svc = LiveQueryService(conf={
+        "datax.job.process.lq.ticker": "true",
+        "datax.job.process.lq.maxbatchwaitms": "4",
+        "datax.job.process.lq.tenant.maxsessions": str(sessions_per_tenant),
+        "datax.job.process.lq.tenant.maxqps": "1000000",
+        "datax.job.process.lq.maxsessions": "4096",
+    })
+    try:
+        sids = [
+            svc.create_session(f"tenant-{t}", "BenchLQ", schema,
+                               sample_rows=rows)["id"]
+            for t in range(tenants) for _ in range(sessions_per_tenant)
+        ]
+        svc.execute(sids[0], query)  # compile once, warm
+
+        done = []
+        done_lock = _threading.Lock()
+
+        def one(sid):
+            try:
+                svc.execute(sid, query)
+                with done_lock:
+                    done.append(time.monotonic())
+            except Exception:
+                pass
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        interval = 1.0 / arrival_rate
+        t0 = time.monotonic()
+        submitted = 0
+        with ThreadPoolExecutor(max_workers=64) as pool:
+            while time.monotonic() - t0 < seconds:
+                pool.submit(one, sids[submitted % len(sids)])
+                submitted += 1
+                # open loop: next arrival is schedule-driven
+                next_at = t0 + submitted * interval
+                delay = next_at - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+        elapsed = max(time.monotonic() - t0, 1e-6)
+        completed = len(done)
+        p99 = svc.histograms.percentile(LQ_FLOW, LQ_EXEC_STAGE, 99)
+        p50 = svc.histograms.percentile(LQ_FLOW, LQ_EXEC_STAGE, 50)
+        co = svc.coalescer.stats()
+        cache = svc.cache.stats()
+    finally:
+        svc.stop()
+
+    # quota proof on a throttled twin: rejected executes must consume
+    # zero dispatches (counted here, 429-surfaced on the REST path)
+    tight = LiveQueryService(conf={
+        "datax.job.process.lq.tenant.maxqps": "1",
+    })
+    try:
+        sid = tight.create_session("freeloader", "BenchLQ", schema,
+                                   sample_rows=rows)["id"]
+        tight.execute(sid, query)  # consumes the 1-token burst
+        before = tight.coalescer.stats()["dispatches"]
+        rejected = 0
+        for _ in range(5):
+            try:
+                tight.execute(sid, query)
+            except AdmissionRejected:
+                rejected += 1
+        rejected_dispatch_delta = (
+            tight.coalescer.stats()["dispatches"] - before
+        )
+    finally:
+        tight.stop()
+
+    return {
+        "kernel_qps": round(completed / elapsed, 1),
+        "p99_exec_ms": round(p99, 2) if p99 is not None else None,
+        "p50_exec_ms": round(p50, 2) if p50 is not None else None,
+        "arrival_rate_qps": arrival_rate,
+        "submitted": submitted,
+        "completed": completed,
+        "sessions": len(sids),
+        "tenants": tenants,
+        "coalesce_fanin_avg": co["avgFanin"],
+        "dispatches": co["dispatches"],
+        "calls": co["calls"],
+        # the scaling proof: tenant count scaled, compile surface did not
+        "compiled_entries": cache["entries"],
+        "step_cache_entries": cache["stepCacheEntries"],
+        "quota_rejected": rejected,
+        "rejected_dispatches": rejected_dispatch_delta,
+    }
+
+
 def regression_gate(current: dict, tolerance: float = 0.10):
     """Trajectory gate: compare this run against the latest committed
     BENCH_r*.json and emit a ``regression`` block — events/s and p99
@@ -800,6 +936,21 @@ def regression_gate(current: dict, tolerance: float = 0.10):
     d_eps = delta("value")
     d_p99_eval = delta("p99_rule_eval_ms")
     d_p99_batch = delta("p99_batch_ms")
+
+    def nested_delta(block, key):
+        a = (prev.get(block) or {}).get(key)
+        b = (current.get(block) or {}).get(key)
+        if not isinstance(a, (int, float)) or not isinstance(b, (int, float)) \
+                or a == 0:
+            return None
+        return round(b / a - 1.0, 4)
+
+    # LiveQuery serving-plane gates (backend-aware like every other
+    # delta — the backend_mismatch short-circuit above already ran):
+    # kernel QPS dropping or p99 interactive latency growing past the
+    # band fails like an events/s drop
+    d_lq_qps = nested_delta("livequery", "kernel_qps")
+    d_lq_p99 = nested_delta("livequery", "p99_exec_ms")
     # cold-start gate: warm time-to-first-batch is the restart/
     # preemption-recovery promise — a >band worsening (or warm no
     # longer beating cold at all) fails like an events/s drop
@@ -822,6 +973,8 @@ def regression_gate(current: dict, tolerance: float = 0.10):
         or (d_p99_batch is not None and d_p99_batch > tolerance)
         or (d_warm_first is not None and d_warm_first > tolerance)
         or (bool(cs_cur) and not cs_cur.get("warm_below_cold", True))
+        or (d_lq_qps is not None and d_lq_qps < -tolerance)
+        or (d_lq_p99 is not None and d_lq_p99 > tolerance)
     )
     return {
         "baseline": os.path.basename(latest),
@@ -830,6 +983,8 @@ def regression_gate(current: dict, tolerance: float = 0.10):
         "p99_rule_eval_delta": d_p99_eval,
         "p99_batch_delta": d_p99_batch,
         "warm_first_batch_delta": d_warm_first,
+        "lq_kernel_qps_delta": d_lq_qps,
+        "lq_p99_exec_delta": d_lq_p99,
         "tolerance": tolerance,
         "regressed": regressed,
     }
@@ -1008,6 +1163,10 @@ def main():
         "cold_start": bench_cold_start(),
         "state_handoff": bench_state_handoff(),
         "pilot": bench_pilot_overhead(),
+        # the "millions of users" axis: interactive kernel QPS + p99
+        # exec latency under multi-tenant open-loop load, published
+        # beside the streaming events/s headline (ROADMAP item 3)
+        "livequery": bench_livequery(),
     }
     reg = regression_gate(result)
     if reg is not None:

@@ -118,6 +118,30 @@ def output_slot_bytes(
     return OUTPUT_SLOT_BUFFERS * view_output_bytes(types, plan, capacity)
 
 
+# fraction of one chip's HBM the LiveQuery serving plane may pin in
+# resident interactive kernels (lq/warmcache.py WarmKernelCache): the
+# production flows placed on the chip own the rest (the DX4xx packer
+# already charges them), so the serving plane takes a bounded slice
+# instead of competing with them allocation-by-allocation
+DEFAULT_LQ_CACHE_HEADROOM = 0.25
+
+
+def warm_kernel_cache_budget_bytes(
+    chip_hbm_bytes: Optional[int] = None,
+    headroom: float = DEFAULT_LQ_CACHE_HEADROOM,
+) -> int:
+    """HBM bytes the LiveQuery warm-kernel LRU may keep resident —
+    ``headroom`` of one chip (the fleet-spec default when unset). Each
+    cache entry is priced with the same DX2xx byte model the fleet
+    packer consumes (``deviceplan.analyze_processor(...).totals()``),
+    so cache occupancy and flow placement share one currency."""
+    if chip_hbm_bytes is None:
+        from .fleetcheck import DEFAULT_HBM_PER_CHIP
+
+        chip_hbm_bytes = DEFAULT_HBM_PER_CHIP
+    return int(chip_hbm_bytes * float(headroom))
+
+
 def runtime_conformance_model(
     totals: Dict[str, object],
     stages: Optional[list] = None,

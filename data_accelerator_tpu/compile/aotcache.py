@@ -49,6 +49,18 @@ def _reset_jax_cache() -> None:
         logger.warning("jax compilation-cache reset unavailable")
 
 
+def compile_conf_for(cache_dir: str,
+                     cache_url: Optional[str] = None) -> dict:
+    """The ``datax.job.process.compile.*`` conf keys that arm this
+    cache for a kernel pool — the one way LiveQuery surfaces (REST
+    kernel pool, serving-plane warm cache, one-box server) build their
+    shared compile conf, so the layers can't drift on key names."""
+    conf = {"datax.job.process.compile.cachedir": cache_dir}
+    if cache_url:
+        conf["datax.job.process.compile.cacheurl"] = cache_url
+    return conf
+
+
 def _parse_objstore_url(url: str) -> Tuple[str, str, str]:
     """objstore://host:port/bucket/prefix -> (endpoint, bucket, prefix)."""
     if url.startswith("objstore+https://"):

@@ -78,11 +78,19 @@ class MetricName:
     LatencyPrefix = "Latency-"
 
     # canonical per-batch stage names (span names == histogram stages ==
-    # the <stage> of Latency-<stage> metrics, modulo capitalization)
+    # the <stage> of Latency-<stage> metrics, modulo capitalization),
+    # plus the LiveQuery serving plane's end-to-end execute stage
+    # ("lq-exec" -> Latency-LQExec, see _STAGE_METRIC_OVERRIDES) — a
+    # STAGES member so alert rules over Latency-LQExec-pNN resolve
+    # through the live histograms like every other stage
     STAGES = (
         "decode", "dispatch", "device-step", "sync", "collect",
-        "sinks", "checkpoint", "batch",
+        "sinks", "checkpoint", "batch", "lq-exec",
     )
+
+    # stages whose metric stem is not the plain CamelCase of the stage
+    # name (acronym casing)
+    _STAGE_METRIC_OVERRIDES = {"lq-exec": "Latency-LQExec"}
 
     # regexes over the metric part of ``DATAX-<flow>:<metric>`` covering
     # everything the engine emits at runtime (host + processor + sinks +
@@ -235,6 +243,30 @@ class MetricName:
         r"Fleet_Chip[0-9]+_(HbmBytes|Utilization)",
         r"Fleet_AdmissionRejected_Count",
         r"Placement_Replans_Count",
+        # LiveQuery serving plane (lq/service.py, exported under the
+        # DATAX-LiveQuery app): live session/tenant gauges, completed
+        # execute QPS over a trailing 10 s window, queued-not-yet-
+        # dispatched calls (the pilot-visible pressure signal the
+        # lq-latency-slo alert rule votes backpressure on), mean calls
+        # merged per dispatch tick, cumulative device dispatches and
+        # calls that shared one (the coalescing win), resident
+        # warm-kernel HBM priced by the DX2xx model, LRU evictions from
+        # the modeled budget, and typed admission/quota rejections
+        # (rejected calls never reach a device dispatch)
+        r"LQ_Sessions",
+        r"LQ_Tenants",
+        r"LQ_Qps",
+        r"LQ_Backlog",
+        r"LQ_CoalesceFanin",
+        r"LQ_Dispatch_Count",
+        r"LQ_Coalesced_Count",
+        r"LQ_KernelBytes",
+        r"LQ_KernelEvict_Count",
+        r"LQ_Admission_Rejected_Count",
+        # end-to-end LiveQuery execute latency (queue wait + coalesced
+        # dispatch), the serving plane's interactive-latency histogram
+        # (exemplar-bearing like every Latency-* family)
+        r"Latency-LQExec-p(50|95|99)",
     )
 
     @classmethod
@@ -256,10 +288,14 @@ class MetricName:
         from the flow name)."""
         return ProductConstant.MetricAppNamePrefix + job_name
 
-    @staticmethod
-    def stage_metric(stage: str) -> str:
+    @classmethod
+    def stage_metric(cls, stage: str) -> str:
         """Histogram stage -> its metric stem, e.g. ``device-step`` ->
-        ``Latency-DeviceStep``."""
+        ``Latency-DeviceStep`` (acronym stages override: ``lq-exec`` ->
+        ``Latency-LQExec``)."""
+        override = cls._STAGE_METRIC_OVERRIDES.get(stage)
+        if override is not None:
+            return override
         camel = "".join(w.capitalize() for w in stage.split("-"))
         return f"Latency-{camel}"
 

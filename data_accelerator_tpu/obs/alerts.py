@@ -216,6 +216,23 @@ def default_rules(flow: Optional[str] = None) -> List[dict]:
                            "are slower than the dispatch loop",
         },
         {
+            # LiveQuery serving plane SLO: p99 end-to-end execute
+            # latency (queue wait + coalesced dispatch, the
+            # Latency-LQExec histogram lq/service.py feeds) over the
+            # interactive threshold. While firing it votes for source
+            # backpressure in the pilot's decision table — the serving
+            # plane and the streaming path share one chip, so shedding
+            # ingest load is the actuator that frees device time
+            "name": "lq-latency-slo",
+            "metric": "Latency-LQExec-p99",
+            "op": ">", "threshold": 1000.0,
+            "windowSeconds": 120, "forSeconds": 20,
+            "severity": "page",
+            "action": "backpressure",
+            "description": "p99 LiveQuery execute latency above the "
+                           "1 s interactive SLO",
+        },
+        {
             "name": "batch-error-burn",
             "slo": {"objective": 0.99}, "burnRate": 2.0,
             "windowSeconds": 300,

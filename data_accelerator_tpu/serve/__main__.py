@@ -133,9 +133,30 @@ def main(argv=None):
         fleet_spec=fleet_spec,
         fleet_admission=args.get("admission", "true") != "false",
     )
+    # LiveQuery serving plane: the real server runs the deadline-tick
+    # dispatcher thread so concurrent tenants' executes micro-batch
+    # (lq.* args override the datax.job.process.lq.* defaults, e.g.
+    # lq.maxbatchwaitms=8 lq.tenant.maxqps=50; lq.ticker=false falls
+    # back to the tickless in-process mode)
+    import os as _os
+
+    from ..compile.aotcache import compile_conf_for
+    from ..lq.service import LiveQueryService
+
+    lq_conf = {
+        f"datax.job.process.lq.{k[3:]}": v
+        for k, v in args.items() if k.startswith("lq.")
+    }
+    lq_conf.setdefault("datax.job.process.lq.ticker", "true")
+    livequery = LiveQueryService(
+        conf=lq_conf,
+        compile_conf=compile_conf_for(_os.path.join(
+            runtime_storage.resolve("livequery"), "compilecache"
+        )),
+    )
     api = DataXApi(
         flow_ops, require_roles=args.get("roles", "false") == "true",
-        tracer=tracer,
+        tracer=tracer, livequery=livequery,
     )
     service = DataXApiService(api, port=port)
     service.start()

@@ -621,6 +621,18 @@ class RuntimeConfigGeneration:
             ("jobProfiler", "observability.profiler"),
             ("jobHbmSample", "observability.hbmsample"),
             ("jobCalibration", "observability.calibration"),
+            # LiveQuery serving plane (lq/service.py): dispatch-tick
+            # deadline, per-tenant quotas and the warm-kernel HBM
+            # budget ride in the conf like every other process knob,
+            # so a serving plane built from this flow's conf honors
+            # the designer's choices
+            ("jobLqMaxBatchWaitMs", "lq.maxbatchwaitms"),
+            ("jobLqMaxFanin", "lq.maxfanin"),
+            ("jobLqSessionTtlSeconds", "lq.sessionttlseconds"),
+            ("jobLqMaxSessions", "lq.maxsessions"),
+            ("jobLqTenantMaxSessions", "lq.tenant.maxsessions"),
+            ("jobLqTenantMaxQps", "lq.tenant.maxqps"),
+            ("jobLqHbmBudgetMb", "lq.hbmbudgetmb"),
         ):
             v = jobconf.get(gui_key)
             if v not in (None, ""):

@@ -22,7 +22,6 @@ import json
 import re
 import threading
 import time
-import uuid
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -250,7 +249,16 @@ class Kernel:
 
 
 class KernelService:
-    """Kernel registry with TTL GC (KernelService.cs:135-190 analog)."""
+    """Kernel registry with TTL GC (KernelService.cs:135-190 analog).
+
+    The registry itself is the serving plane's ``SessionManager``
+    (``lq/session.py``) — kernels live as sessions under the legacy
+    tenant, so BOTH interactive surfaces (these designer kernels and
+    the multi-tenant ``lq/`` session service) share one registry, one
+    TTL clock and one reap pass. That also fixes the old leak: GC used
+    to run only inside ``create_kernel``, so REST-created kernels whose
+    designer stopped creating new ones were never reaped; the shared
+    manager reaps on EVERY access path (create, get, execute, list)."""
 
     def __init__(
         self,
@@ -258,15 +266,18 @@ class KernelService:
         ttl_s: float = DEFAULT_KERNEL_TTL_S,
         max_kernels: int = DEFAULT_MAX_KERNELS,
         compile_conf: Optional[Dict[str, str]] = None,
+        session_manager=None,
     ):
+        from ..lq.session import LEGACY_TENANT, SessionManager
+
         self.runtime = runtime_storage
-        self.ttl_s = ttl_s
         self.max_kernels = max_kernels
         # shared persistent-compile-cache conf applied to every kernel
         # (see Kernel.compile_conf)
         self.compile_conf = dict(compile_conf or {})
-        self._kernels: Dict[str, Kernel] = {}
-        self._lock = threading.Lock()
+        self._tenant = LEGACY_TENANT
+        self.sessions = session_manager or SessionManager(ttl_s=ttl_s)
+        self.ttl_s = self.sessions.ttl_s
 
     # -- lifecycle -------------------------------------------------------
     def create_kernel(
@@ -289,9 +300,8 @@ class KernelService:
             sample_rows = self._load_sample(flow_name)
         if not isinstance(schema_json, str):
             schema_json = json.dumps(schema_json)
-        kid = uuid.uuid4().hex[:12]
         kernel = Kernel(
-            id=kid,
+            id="",
             flow_name=flow_name,
             schema_json=schema_json,
             normalization=normalization,
@@ -301,10 +311,18 @@ class KernelService:
             debug=debug,
             compile_conf=dict(self.compile_conf),
         )
-        with self._lock:
-            self._gc_locked()
-            self._kernels[kid] = kernel
-        return kid
+        # legacy policy: evict the oldest-idle kernel when this
+        # surface's cap is reached (the designer's recycle-oldest
+        # behavior), instead of the serving plane's 429 rejection
+        session = self.sessions.create(
+            tenant=self._tenant,
+            flow_name=flow_name,
+            payload=kernel,
+            evict_on_full=True,
+            cap=self.max_kernels,
+        )
+        kernel.id = session.id
+        return session.id
 
     def has_sample(self, flow_name: str) -> bool:
         """True when a persisted sample blob exists for the flow."""
@@ -328,11 +346,16 @@ class KernelService:
         ]
 
     def get(self, kernel_id: str) -> Kernel:
-        with self._lock:
-            k = self._kernels.get(kernel_id)
-        if k is None:
+        # the shared manager reaps expired sessions on every get — a
+        # REST-created kernel left idle past its TTL is recycled here,
+        # not only when the next create happens to run
+        try:
+            session = self.sessions.get(kernel_id)
+        except KeyError:
             raise KeyError(f"kernel '{kernel_id}' not found (recycled?)")
-        return k
+        if session.tenant != self._tenant or session.payload is None:
+            raise KeyError(f"kernel '{kernel_id}' not found (recycled?)")
+        return session.payload
 
     def execute(
         self, kernel_id: str, query: str, max_rows: int = DEFAULT_MAX_ROWS
@@ -340,42 +363,23 @@ class KernelService:
         return self.get(kernel_id).execute(query, max_rows)
 
     def delete_kernel(self, kernel_id: str) -> bool:
-        with self._lock:
-            return self._kernels.pop(kernel_id, None) is not None
+        return self.sessions.close(kernel_id)
 
     def delete_kernels(self, flow_name: Optional[str] = None) -> int:
         """Recycle all kernels (optionally per flow)."""
-        with self._lock:
-            doomed = [
-                kid for kid, k in self._kernels.items()
-                if flow_name is None or k.flow_name == flow_name
-            ]
-            for kid in doomed:
-                del self._kernels[kid]
-            return len(doomed)
+        return self.sessions.close_where(
+            flow_name=flow_name, tenant=self._tenant
+        )
 
     def list_kernels(self) -> List[dict]:
-        with self._lock:
-            return [
-                {
-                    "id": k.id,
-                    "flow": k.flow_name,
-                    "createdAt": k.created_at,
-                    "lastUsed": k.last_used,
-                    "sampleRows": len(k.sample_rows),
-                }
-                for k in self._kernels.values()
-            ]
-
-    # -- GC --------------------------------------------------------------
-    def _gc_locked(self) -> None:
-        now = time.time()
-        expired = [
-            kid for kid, k in self._kernels.items()
-            if now - k.last_used > self.ttl_s
+        return [
+            {
+                "id": s.id,
+                "flow": s.flow_name,
+                "createdAt": s.created_at,
+                "lastUsed": s.last_used,
+                "sampleRows": len(s.payload.sample_rows)
+                if s.payload is not None else 0,
+            }
+            for s in self.sessions.list(tenant=self._tenant)
         ]
-        for kid in expired:
-            del self._kernels[kid]
-        while len(self._kernels) >= self.max_kernels:
-            oldest = min(self._kernels.values(), key=lambda k: k.last_used)
-            del self._kernels[oldest.id]

@@ -35,6 +35,8 @@ from typing import Callable, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from ..compile.codegen import CodegenEngine
+from ..lq.service import LiveQueryService
+from ..lq.session import AdmissionRejected, SessionManager
 from ..obs import tracing
 from .flowservice import FlowOperation
 from .jobs import FleetAdmissionError
@@ -64,6 +66,7 @@ class DataXApi:
         kernels: Optional[KernelService] = None,
         require_roles: bool = False,
         tracer: Optional[tracing.Tracer] = None,
+        livequery: Optional[LiveQueryService] = None,
     ):
         # control-plane request tracing: each dispatched route becomes a
         # `rest/<path>` trace whose id flows through job submit ->
@@ -77,14 +80,34 @@ class DataXApi:
         # whole control plane) deserialize query compiles instead of
         # re-tracing them — the warm-LiveQuery-pool half of the AOT
         # compile path (runtime/processor.py process.compile.*)
-        self.kernels = kernels or KernelService(
-            runtime_storage=flow_ops.runtime,
-            compile_conf={
-                "datax.job.process.compile.cachedir": os.path.join(
-                    flow_ops.runtime.resolve("livequery"), "compilecache"
-                ),
-            },
-        )
+        from ..compile.aotcache import compile_conf_for
+
+        compile_conf = compile_conf_for(os.path.join(
+            flow_ops.runtime.resolve("livequery"), "compilecache"
+        ))
+        # ONE session registry behind both interactive surfaces: the
+        # legacy designer kernels (kernel/* routes, TTL-reaped now) and
+        # the multi-tenant serving plane (lq/* routes, quota'd). The
+        # in-process LiveQuery default runs tickless (each execute
+        # flushes its own dispatch tick — still coalescing whatever
+        # queued concurrently); ``serve/__main__`` passes a ticker'd
+        # instance for the real server.
+        if kernels is not None:
+            self.kernels = kernels
+            self.livequery = livequery or LiveQueryService(
+                session_manager=kernels.sessions,
+                compile_conf=compile_conf,
+            )
+        else:
+            self.livequery = livequery or LiveQueryService(
+                session_manager=SessionManager(),
+                compile_conf=compile_conf,
+            )
+            self.kernels = KernelService(
+                runtime_storage=flow_ops.runtime,
+                compile_conf=compile_conf,
+                session_manager=self.livequery.sessions,
+            )
         self.schema_inference = SchemaInferenceManager(flow_ops.runtime)
         self.analyzer = SqlAnalyzer()
         self.codegen = CodegenEngine()
@@ -120,6 +143,14 @@ class DataXApi:
         r[("POST", "kernel/delete")] = (self._kernel_delete, True)
         r[("POST", "kernels/deleteall")] = (self._kernels_deleteall, True)
         r[("GET", "kernels/list")] = (self._kernels_list, False)
+        # LiveQuery serving plane (lq/): multi-tenant sessions with
+        # micro-batched dispatch; quota rejections surface as 429 +
+        # Retry-After (see _dispatch_traced / DataXApiService._respond)
+        r[("POST", "lq/session")] = (self._lq_session_create, False)
+        r[("POST", "lq/execute")] = (self._lq_execute, False)
+        r[("POST", "lq/session/close")] = (self._lq_session_close, False)
+        r[("GET", "lq/sessions")] = (self._lq_sessions_list, False)
+        r[("GET", "lq/stats")] = (self._lq_stats, False)
 
     # -- dispatch --------------------------------------------------------
     def dispatch(
@@ -139,7 +170,8 @@ class DataXApi:
         # serves all four service families, so drop it when present
         head, _, rest = path.partition("/")
         if head in (
-            "flow", "interactivequery", "schemainference", "livedata"
+            "flow", "interactivequery", "schemainference", "livedata",
+            "livequery",
         ) and (method.upper(), path) not in self.routes:
             path = rest
         entry = self.routes.get((method.upper(), path))
@@ -173,6 +205,13 @@ class DataXApi:
             return 200, {"result": result}
         except ApiError as e:
             return e.status, {"error": {"message": str(e)}}
+        except AdmissionRejected as e:
+            # serving-plane quota/capacity rejection: typed 429 the
+            # caller can back off on — the rejected call NEVER queued,
+            # so it consumed no kernel compile and no device dispatch.
+            # DataXApiService turns retryAfterSeconds into the
+            # Retry-After response header.
+            return 429, {"error": e.to_dict()}
         except FleetAdmissionError as e:
             # fleet admission gate: the submit conflicts with the
             # current fleet state (DX400/401/410/411) — a client
@@ -320,10 +359,11 @@ class DataXApi:
         return self.flow_ops.schedule_batch(self._flow_name(body, query))
 
     def _flow_delete(self, body, query):
-        """Cascade delete incl. the flow's live kernels
+        """Cascade delete incl. the flow's live kernels + LQ sessions
         (DataX.Flow.DeleteHelper deletes configs/checkpoints/kernels)."""
         name = self._flow_name(body, query)
         self.kernels.delete_kernels(name)
+        self.livequery.close_flow(name)
         return {"deleted": self.flow_ops.delete_flow(name)}
 
     def _flow_get(self, body, query):
@@ -522,6 +562,43 @@ class DataXApi:
     def _kernels_list(self, body, query):
         return self.kernels.list_kernels()
 
+    # -- LiveQuery serving plane (lq/) -----------------------------------
+    def _lq_session_create(self, body, query):
+        """Create a tenant session. Flow fields resolve exactly like a
+        legacy kernel create (saved flow name, inline schema, persisted
+        or generated sample); per-tenant session quotas are enforced
+        here — over-quota tenants get 429 + Retry-After, not a kernel."""
+        kw = self._kernel_body(body)
+        return self.livequery.create_session(
+            tenant=str(body.get("tenant") or "default"),
+            flow_name=kw["flow_name"],
+            schema_json=kw["schema_json"],
+            normalization=kw["normalization"],
+            sample_rows=kw["sample_rows"],
+            debug=kw["debug"],
+        )
+
+    def _lq_execute(self, body, query):
+        sid = body.get("sessionId")
+        if not sid:
+            raise ApiError("sessionId required")
+        return self.livequery.execute(
+            sid, body.get("query") or "", int(body.get("maxRows") or 100)
+        )
+
+    def _lq_session_close(self, body, query):
+        sid = body.get("sessionId")
+        if not sid:
+            raise ApiError("sessionId required")
+        return {"closed": self.livequery.close_session(sid)}
+
+    def _lq_sessions_list(self, body, query):
+        tenant = (query.get("tenant") or [None])[0] or body.get("tenant")
+        return self.livequery.list_sessions(tenant=tenant)
+
+    def _lq_stats(self, body, query):
+        return self.livequery.snapshot()
+
 
 class DataXApiService:
     """HTTP host for DataXApi (ThreadingHTTPServer)."""
@@ -539,6 +616,17 @@ class DataXApiService:
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                if status == 429:
+                    # quota rejections carry a typed retry hint
+                    # (lq/session.py AdmissionRejected.to_dict) —
+                    # surface it as the standard backoff header
+                    retry = (payload.get("error") or {}).get(
+                        "retryAfterSeconds"
+                    )
+                    if isinstance(retry, (int, float)):
+                        self.send_header(
+                            "Retry-After", str(max(1, int(-(-retry // 1))))
+                        )
                 self.end_headers()
                 self.wfile.write(data)
 

@@ -559,6 +559,13 @@ route("#/flow/", async (view, hash) => {
     pane.append(h("div", { class: "muted" },
       "capacity shards over the chip mesh; collectives ride ICI; " +
       "decoder shards fan the host-side ingest parse across cores"));
+    pane.append(field(gui.process.jobconfig, "jobLqMaxBatchWaitMs", "LiveQuery batch wait (ms)", { ph: "8" }));
+    pane.append(field(gui.process.jobconfig, "jobLqTenantMaxSessions", "LiveQuery sessions/tenant", { ph: "8" }));
+    pane.append(field(gui.process.jobconfig, "jobLqTenantMaxQps", "LiveQuery QPS/tenant", { ph: "50" }));
+    pane.append(h("div", { class: "muted" },
+      "LiveQuery serving plane: executes queue per compile signature and " +
+      "micro-batch into one device dispatch per tick; over-quota tenants " +
+      "get 429 + Retry-After"));
   } else if (tab === "schedule") {
     const list = h("div", {});
     const renderBatches = () => {

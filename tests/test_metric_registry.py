@@ -230,6 +230,34 @@ def test_state_partition_metrics_are_registered():
     assert not MetricName.is_runtime_metric("State_Partition_Bogus")
 
 
+def test_lq_serving_metrics_are_registered():
+    """Every LQ_* / Latency-LQExec series the LiveQuery serving plane
+    emits (lq/service.py export_metrics under DATAX-LiveQuery) resolves
+    through the registry; emission-side coverage is
+    tests/test_lq.py::TestObservability."""
+    for m in (
+        "LQ_Sessions",
+        "LQ_Tenants",
+        "LQ_Qps",
+        "LQ_Backlog",
+        "LQ_CoalesceFanin",
+        "LQ_Dispatch_Count",
+        "LQ_Coalesced_Count",
+        "LQ_KernelBytes",
+        "LQ_KernelEvict_Count",
+        "LQ_Admission_Rejected_Count",
+        "Latency-LQExec-p50",
+        "Latency-LQExec-p95",
+        "Latency-LQExec-p99",
+    ):
+        assert MetricName.is_runtime_metric(m), m
+    assert not MetricName.is_runtime_metric("LQ_Bogus")
+    assert not MetricName.is_runtime_metric("Latency-LQExec-p42")
+    # the serving-plane stage round-trips like every engine stage
+    assert "lq-exec" in MetricName.STAGES
+    assert MetricName.stage_metric("lq-exec") == "Latency-LQExec"
+
+
 def test_default_alert_rules_validate_and_resolve_for_shipped_flows():
     """CI satellite: the default-generated alert rules are
     schema-valid, and every threshold rule's series name resolves
