@@ -678,6 +678,51 @@ def bench_state_handoff():
         shutil.rmtree(wd, ignore_errors=True)
 
 
+def bench_sanitizer(capacity=8192, warmup=2, iters=8):
+    """Buffer-sanitizer overhead block: the debug mode's cost (one
+    memset per released pool slot + the sentinel/alias scans at
+    collect) measured as events/s with the sanitizer armed vs off.
+    Published, not gated: it is a debug mode, and the number makes
+    arming it during an incident an informed choice. ``poison_hits``
+    doubles as a live engine check — any nonzero means a pooled view
+    escaped on the bench flow itself."""
+    from data_accelerator_tpu.runtime.sanitizer import BufferSanitizer
+
+    base_ms = 1_800_000_000_000
+
+    def run(armed):
+        proc = build_processor(capacity)
+        if armed:
+            # attached before the first encode, so every ingest pool is
+            # created with the poison-on-release hook wired
+            proc.buffer_sanitizer = BufferSanitizer()
+        payload = make_json_payload(proc, capacity, seed=29)
+        for i in range(warmup):
+            raw = proc.encode_json_bytes(payload, base_ms + i * 1000)
+            proc.process_batch(raw, batch_time_ms=base_ms + i * 1000)
+        t0 = time.perf_counter()
+        for i in range(iters):
+            t_ms = base_ms + (warmup + i) * 1000
+            raw = proc.encode_json_bytes(payload, t_ms)
+            proc.process_batch(raw, batch_time_ms=t_ms)
+        dt = time.perf_counter() - t0
+        return capacity * iters / dt, proc
+
+    # armed phase first: process-wide warmup (XLA autotune, allocator
+    # pools) then favors the OFF run, so the published overhead is the
+    # conservative (overstated) side of the truth
+    on_eps, proc = run(True)
+    off_eps, _ = run(False)
+    san = proc.buffer_sanitizer
+    return {
+        "events_per_sec_off": round(off_eps, 1),
+        "events_per_sec_on": round(on_eps, 1),
+        "overhead_pct": round((1.0 - on_eps / off_eps) * 100.0, 2),
+        "slots_poisoned": san.poison_count,
+        "poison_hits": san.poison_hits,
+    }
+
+
 def bench_pilot_overhead(iters=2000):
     """Autopilot hot-path overhead block: the pilot rides the dispatch
     loop (``tick`` per iteration, ``admit_events`` + ``observe_poll``
@@ -1162,6 +1207,10 @@ def main():
         }),
         "cold_start": bench_cold_start(),
         "state_handoff": bench_state_handoff(),
+        # debug-mode cost of the DX805 buffer sanitizer (poison +
+        # scan), published so arming it in production is an informed
+        # choice; no regression gate
+        "sanitizer": bench_sanitizer(),
         "pilot": bench_pilot_overhead(),
         # the "millions of users" axis: interactive kernel QPS + p99
         # exec latency under multi-tenant open-loop load, published

@@ -4,7 +4,7 @@ Design-time counterpart to the runtime compiler — reuses the production
 codegen + parsers so a bad flow config fails in milliseconds with a
 ``DXnnn``-coded diagnostic instead of minutes into a deployed job.
 
-Five tiers:
+Seven tiers:
 
 - the semantic tier (``analyze_flow``): reference resolution, type
   propagation, legality, dead flow, device-compilation risk;
@@ -29,12 +29,19 @@ Five tiers:
   forced reshard edges, closed-form collective bytes over chips N —
   cross-checked exactly against a real ``Mesh`` lowering, with the
   DX7xx lints and the sharding-plan artifact mesh jobs' confs embed
-  for runtime ICI-drift conformance (``meshcheck.py``).
+  for runtime ICI-drift conformance (``meshcheck.py``);
+- the race tier (``analyze_flow_race``): buffer-lifetime/concurrency
+  abstract interpretation of the ENGINE's own modules (``runtime/``,
+  ``lq/``, ``pilot/``) under a buffer-provenance lattice — the DX8xx
+  escaped-donated-view / zero-copy / lockset / re-donation /
+  blocking-sync lints (``racecheck.py``); its dynamic counterpart is
+  ``runtime/sanitizer.py`` (runtime DX805, conf
+  ``process.debug.buffersanitizer``).
 
 CLI: ``python -m data_accelerator_tpu.analysis flow.json [--json]
 [--device [--chips N]] [--udfs] [--fleet [--fleet-spec=spec.json]]
 [--compile [--manifest=m.json] [--manifest-out=m.json]]
-[--mesh [--chips N]] [--all]``
+[--mesh [--chips N]] [--race] [--all]``
 (non-zero exit on error-severity diagnostics, optional tiers included
 when requested; ``--all`` runs every tier in one invocation).
 """
@@ -91,6 +98,14 @@ from .meshcheck import (
     analyze_flow_mesh,
     analyze_processor_mesh,
 )
+from .racecheck import (
+    ENGINE_PACKAGES,
+    RaceCheckReport,
+    RaceModuleSummary,
+    analyze_flow_race,
+    analyze_modules,
+    engine_module_paths,
+)
 from .typeprop import TableScope, schema_to_types
 from .udfcheck import (
     UdfCheckReport,
@@ -111,6 +126,9 @@ __all__ = [
     "DEFAULT_MAX_STATE_ROWS",
     "DevicePlanReport",
     "Diagnostic",
+    "ENGINE_PACKAGES",
+    "RaceCheckReport",
+    "RaceModuleSummary",
     "MeshPlanReport",
     "MeshStage",
     "ReshardEdge",
@@ -135,7 +153,9 @@ __all__ = [
     "analyze_flow_compile",
     "analyze_flow_device",
     "analyze_flow_mesh",
+    "analyze_flow_race",
     "analyze_flow_udfs",
+    "analyze_modules",
     "analyze_processor",
     "analyze_processor_compile",
     "analyze_processor_mesh",
@@ -143,6 +163,7 @@ __all__ = [
     "parse_chip_count",
     "check_udf_object",
     "combined_report_dict",
+    "engine_module_paths",
     "flow_footprint",
     "load_fleet_spec",
     "pack_fleet",

@@ -4,7 +4,7 @@
         [--json] [--device] [--chips=N] [--udfs]
         [--fleet] [--fleet-spec=spec.json]
         [--compile] [--manifest=m.json] [--manifest-out=m.json]
-        [--mesh] [--all]
+        [--mesh] [--race] [--all]
 
 Each argument is a flow config file: either a designer gui JSON or a
 full flow document (``{"gui": {...}}``). Prints one line per diagnostic
@@ -58,10 +58,22 @@ mesh size (default 8, the MULTICHIP slice); the one ``--chips`` flag
 feeds the device tier's ICI model and the mesh tier alike, and a
 non-positive or non-integer value exits 2. Same exit contract.
 
+``--race`` runs the buffer-lifetime/concurrency tier
+(``analysis/racecheck.py``): unlike the flow tiers its subject is the
+ENGINE the flow deploys onto — every ``runtime/``, ``lq/`` and
+``pilot/`` module is abstract-interpreted under a buffer-provenance
+lattice (donated ring / pool slot / transfer slot / plain), emitting
+the DX8xx lints: escaped donated/pooled views (DX800), unannotated
+zero-copy ``asarray`` (DX801), lockset/lock-ordering violations
+(DX802), slot re-donation before its land ack (DX803), and blocking
+syncs on non-blocking threads (DX804). A clean report certifies the
+runtime for ANY flow, so the result is cached per engine-source state.
+Same exit contract — this is the standing CI race gate.
+
 ``--all`` runs every tier in one invocation (semantic + device + udfs
-+ fleet + compile + mesh) with one merged ``--json`` report (single
-``schemaVersion``, combined diagnostics, same 0/1/2 exit contract) —
-one CI call instead of six flags.
++ fleet + compile + mesh + race) with one merged ``--json`` report
+(single ``schemaVersion``, combined diagnostics, same 0/1/2 exit
+contract) — one CI call instead of seven flags.
 
 Unknown ``--`` flags are rejected with exit 2 (a typo like ``--devcie``
 must not silently skip a tier and report a false clean pass).
@@ -181,7 +193,7 @@ def _print_fleet_plan(fleet) -> None:
 # flags the CLI understands; anything else --prefixed is a usage error
 # (a typo like --devcie must not silently skip a tier)
 KNOWN_FLAGS = {"--json", "--device", "--udfs", "--fleet", "--compile",
-               "--mesh", "--all"}
+               "--mesh", "--race", "--all"}
 KNOWN_VALUE_FLAGS = ("--chips=", "--fleet-spec=", "--manifest=",
                      "--manifest-out=")
 
@@ -197,6 +209,7 @@ def main(argv: List[str]) -> int:
     fleet_tier = "--fleet" in argv or all_tiers
     compile_tier = "--compile" in argv or all_tiers
     mesh_tier = "--mesh" in argv or all_tiers
+    race_tier = "--race" in argv or all_tiers
     chips: Optional[int] = None
     fleet_spec_path: Optional[str] = None
     manifest_path: Optional[str] = None
@@ -253,6 +266,7 @@ def main(argv: List[str]) -> int:
     from .deviceplan import analyze_flow_device, combined_report_dict
     from .diagnostics import REPORT_SCHEMA_VERSION
     from .meshcheck import analyze_flow_mesh
+    from .racecheck import analyze_flow_race
     from .udfcheck import analyze_flow_udfs
 
     shipped_manifest = None
@@ -299,6 +313,7 @@ def main(argv: List[str]) -> int:
             if compile_tier else None
         )
         mesh = analyze_flow_mesh(flow, chips=chips) if mesh_tier else None
+        race = analyze_flow_race(flow) if race_tier else None
         any_errors |= not report.ok
         if device is not None:
             any_errors |= not device.ok
@@ -311,16 +326,19 @@ def main(argv: List[str]) -> int:
                     json.dump(comp.manifest, f, indent=1)
         if mesh is not None:
             any_errors |= not mesh.ok
+        if race is not None:
+            any_errors |= not race.ok
         if as_json:
             if (
                 device is not None or udfs is not None
                 or comp is not None or mesh is not None
+                or race is not None
             ):
                 json_out.append({
                     "file": path,
                     **combined_report_dict(
                         report, device, udfs, compile_surface=comp,
-                        mesh=mesh,
+                        mesh=mesh, race=race,
                     ),
                 })
             else:
@@ -330,7 +348,9 @@ def main(argv: List[str]) -> int:
                 list(device.diagnostics) if device is not None else []
             ) + (list(udfs.diagnostics) if udfs is not None else []) + (
                 list(comp.diagnostics) if comp is not None else []
-            ) + (list(mesh.diagnostics) if mesh is not None else [])
+            ) + (list(mesh.diagnostics) if mesh is not None else []) + (
+                list(race.diagnostics) if race is not None else []
+            )
             for d in diags:
                 print(f"{path}: {d.render()}")
             n_e = len([d for d in diags if d.is_error])
@@ -357,6 +377,15 @@ def main(argv: List[str]) -> int:
                 )
             if mesh is not None and mesh.stages:
                 _print_mesh_plan(path, mesh)
+            if race is not None:
+                rd = race.race_dict()
+                print(
+                    f"{path}: race gate: {rd['analyzedFiles']} engine "
+                    f"module(s) analyzed, "
+                    f"{rd['allowedZeroCopySites']} pinned zero-copy "
+                    f"site(s), {rd['ownerHandoffSites']} owner "
+                    f"handoff(s)"
+                )
 
     fleet = None
     if fleet_tier:

@@ -260,17 +260,18 @@ def _ordered(diags: List[Diagnostic]) -> List[Diagnostic]:
 
 def combined_report_dict(
     base: AnalysisReport, device: Optional[DevicePlanReport] = None,
-    udfs=None, fleet=None, compile_surface=None, mesh=None,
+    udfs=None, fleet=None, compile_surface=None, mesh=None, race=None,
 ) -> dict:
     """Merge the semantic tier with the optional device, UDF, fleet,
-    compile and mesh tiers into one response: a superset of
+    compile, mesh and race tiers into one response: a superset of
     ``AnalysisReport.to_dict()`` plus a ``device`` cost report, a
     ``udfs`` summary, a ``fleet`` placement plan, a ``compile``
-    surface+manifest and/or a ``mesh`` sharding plan — what
-    ``flow/validate`` returns with ``device: true`` / ``udfs: true`` /
-    ``fleet: true`` / ``compile: true`` / ``mesh: true`` (or ``all:
-    true``) and what the CLI's tier flags (or ``--all``) ``--json``
-    print: one ``schemaVersion``, one merged diagnostics list, one exit
+    surface+manifest, a ``mesh`` sharding plan and/or a ``race``
+    engine buffer-lifetime gate — what ``flow/validate`` returns with
+    ``device: true`` / ``udfs: true`` / ``fleet: true`` / ``compile:
+    true`` / ``mesh: true`` / ``race: true`` (or ``all: true``) and
+    what the CLI's tier flags (or ``--all``) ``--json`` print: one
+    ``schemaVersion``, one merged diagnostics list, one exit
     contract."""
     from .diagnostics import REPORT_SCHEMA_VERSION
 
@@ -285,6 +286,8 @@ def combined_report_dict(
         diags += list(compile_surface.diagnostics)
     if mesh is not None:
         diags += list(mesh.diagnostics)
+    if race is not None:
+        diags += list(race.diagnostics)
     diags = _ordered(diags)
     errors = [d for d in diags if d.is_error]
     out = {
@@ -304,6 +307,8 @@ def combined_report_dict(
         out["compile"] = compile_surface.compile_dict()
     if mesh is not None:
         out["mesh"] = mesh.mesh_dict()
+    if race is not None:
+        out["race"] = race.race_dict()
     return out
 
 

@@ -214,6 +214,22 @@ CODES: Dict[str, tuple] = {
               "fix the statement per the lowering error (it is the production compiler's own failure, seen early)"),
     "DX691": (SEV_WARNING, "compile-surface analysis unavailable: no concrete input schema, design-time-unloadable UDF, or unreadable reference data",
               "inline the input schema JSON, make UDF modules importable on the control plane, and keep refdata CSVs readable at design time"),
+    # -- pass 11: buffer lifetime / concurrency (analysis/racecheck.py,
+    #    the --race tier: provenance-lattice abstract interpretation of
+    #    the ENGINE'S OWN runtime/lq/pilot modules — the standing CI
+    #    race gate against the donated/zero-copy bug class. DX805 is
+    #    the runtime half (runtime/sanitizer.py), fired into the
+    #    flight recorder, never by the static pass) -------------------
+    "DX800": (SEV_ERROR, "donated/pooled buffer view escapes its guarded scope (return, attribute/container store, or cross-thread handoff) without a real copy: the next dispatch donates/reuses the memory under the escaped view — use-after-free, not just stale data",
+              "copy before the escape (np.array(x, copy=True) / .copy()), or mark a designed ownership transfer with '# dx-race: owner-handoff <reason>'"),
+    "DX801": (SEV_ERROR, "np.asarray/jnp.asarray of an aligned pool/ring buffer outside an annotated allowed-zero-copy site: on the CPU backend this is a zero-copy VIEW of memory the engine will donate or reuse",
+              "use a real copy, or annotate the site '# dx-race: allow-zero-copy <reason>' if the view provably dies before the buffer is donated/reused"),
+    "DX802": (SEV_ERROR, "shared state raced between the dispatch loop and a background thread: an attribute guarded by a lock elsewhere is mutated without that lock, or two locks are acquired in conflicting orders",
+              "take the associated lock around the write (or mark a provably pre-thread path '# dx-race: single-threaded <reason>'); keep lock acquisition order consistent with the device-state lock"),
+    "DX803": (SEV_ERROR, "transfer slot re-donated before its land ack: donation of an A/B slot buffer is not dominated by the previous batch's landed-event check, so XLA may free a buffer the background landing thread is still reading",
+              "gate the donation on the previous slot's _landed.is_set()/wait() (the slot-rotation contract the compile manifest's donate pattern assumes)"),
+    "DX804": (SEV_ERROR, "blocking device sync on a thread the pipeline model requires non-blocking: block_until_ready/device_get/a blocking wait inside a function marked '# dx-race: non-blocking' stalls the dispatch overlap the depth-N window exists to provide",
+              "move the sync to the landing thread (collect_counts is the one sanctioned sync point), use the async copy path, or drop the non-blocking marker if the function is genuinely allowed to block"),
 }
 
 # which pass each code family belongs to (for grouping/reporting)
@@ -233,6 +249,7 @@ PASS_NAMES = {
     "DX69": "compile surface",
     "DX70": "mesh sharding",
     "DX79": "mesh sharding",
+    "DX80": "buffer lifetime/race",
 }
 
 # version of every ``--json`` report shape the analysis tiers emit (the
@@ -241,7 +258,9 @@ PASS_NAMES = {
 # admission gate, CI tooling) can detect report-format drift; a tier-1
 # test pins the current key sets against this number.
 # v2: the ``mesh`` report block (the --mesh tier's sharding plan).
-REPORT_SCHEMA_VERSION = 2
+# v3: the ``race`` report block (the --race tier's engine buffer-
+# lifetime/concurrency gate).
+REPORT_SCHEMA_VERSION = 3
 
 
 def make(code: str, table: str, message: str, span: Optional[Span] = None,

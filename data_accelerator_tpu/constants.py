@@ -136,6 +136,12 @@ class MetricName:
         r"Transfer_D2HBytes",
         r"Transfer_Efficiency",
         r"Transfer_(AsyncCopyFallback|Overflow|SlotContended)_Count",
+        # buffer sanitizer (runtime/sanitizer.py, armed via
+        # process.debug.buffersanitizer): buffers guarded per collect,
+        # and use-after-release detections — runtime DX805, the dynamic
+        # half of the DX8xx buffer-lifetime analyzer
+        r"Sanitizer_GuardedViews_Count",
+        r"Sanitizer_PoisonHit_Count",
         # device-resident result path (runtime/processor.py
         # collect_counts + runtime/host.py background landing): bytes
         # the blocking counts-only sync moved, landings still queued

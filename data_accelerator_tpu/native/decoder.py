@@ -234,6 +234,12 @@ class PackedBufferPool:
         return self._new_matrix()
 
     def release(self, matrix: np.ndarray) -> None:
+        # an attached BufferSanitizer (debug.buffersanitizer) poisons
+        # the slot on release: the pool owns it now, so any sentinel
+        # that later surfaces downstream is a use-after-release
+        san = getattr(self, "sanitizer", None)
+        if san is not None:
+            san.poison(matrix)
         with self._lock:
             self._free.append(matrix)
 

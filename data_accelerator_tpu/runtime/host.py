@@ -706,6 +706,19 @@ class StreamingHost:
         # DX53x state events (load fallback / both-sides-bad) land in
         # the flight recorder like conformance drift — typed, greppable
         self._drain_state_events()
+        # runtime DX805: buffer-sanitizer poison hits join the recorder
+        # the same way (and the Sanitizer_PoisonHit metric event stream)
+        san = self.processor.buffer_sanitizer
+        if san is not None:
+            for ev in san.drain_events():
+                try:
+                    self.telemetry.track_event("sanitizer/poison", ev)
+                    self.metric_logger.send_metric_events(
+                        "Sanitizer_PoisonHit", [ev], batch_time_ms
+                    )
+                except Exception:  # noqa: BLE001 — telemetry never kills a batch
+                    logger.exception("sanitizer event emit failed")
+                logger.warning("buffer sanitizer %s", ev.get("message"))
         if self.checkpointer and (
             t0 - self._last_checkpoint >= self.checkpoint_interval_s
         ):
@@ -717,6 +730,15 @@ class StreamingHost:
                     # duplicates); the reverse order would resume PAST events
                     # the restored rings never saw — a hole in window history
                     snap = self.processor.snapshot_window_state()
+                    # armed sanitizer: a checkpoint must be REAL copies
+                    # — shared memory with the live rings (or sentinel
+                    # residue) is the PR 13 bug, caught before the
+                    # snapshot is ever persisted
+                    san = self.processor.buffer_sanitizer
+                    if san is not None:
+                        san.check_snapshot(
+                            snap, self.processor.window_buffers
+                        )
                     self.window_checkpointer.save(snap)
                     if self.processor.state_mirror is not None:
                         # ship the owned window partitions (A/B + pointer

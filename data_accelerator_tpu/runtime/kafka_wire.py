@@ -637,10 +637,16 @@ class WireKafkaConsumer(KafkaWireClient):
             self._refresh_metadata()
         deadline = time.time() + max(timeout, 0.0)
         for (topic, partition), leader in sorted(self._leaders.items()):
-            pos = self._positions.get((topic, partition))
+            with self._lock:
+                pos = self._positions.get((topic, partition))
             if pos is None:
+                # list-offset is a network round trip — resolve it outside
+                # the lock, then publish under it (seek() may race us)
                 pos = self._list_offset(topic, partition, -2)
-                self._positions[(topic, partition)] = pos
+                with self._lock:
+                    pos = self._positions.setdefault(
+                        (topic, partition), pos
+                    )
             s = self._connect(*leader)
             wait_ms = max(0, int((deadline - time.time()) * 1000))
             body = (
@@ -675,7 +681,9 @@ class WireKafkaConsumer(KafkaWireClient):
                             "kafka fetch error %d on %s/%d", err, tname, pidx
                         )
                         continue
-                    yield tname, pidx, self._positions[(tname, pidx)], records
+                    with self._lock:
+                        cur = self._positions[(tname, pidx)]
+                    yield tname, pidx, cur, records
 
     def _fill(self, timeout: float) -> None:
         for tname, pidx, pos, records in self._fetch_pass(timeout):
@@ -698,8 +706,9 @@ class WireKafkaConsumer(KafkaWireClient):
                 next_off,
                 (msgs[-1].offset() + 1) if msgs else -1,
             )
-            if new_pos > self._positions[pos_key]:
-                self._positions[pos_key] = new_pos
+            with self._lock:
+                if new_pos > self._positions[pos_key]:
+                    self._positions[pos_key] = new_pos
 
     def fetch_raw(self, timeout: float = 0.05):
         """The binary fast path's fetch: one Fetch round returning RAW
@@ -724,8 +733,9 @@ class WireKafkaConsumer(KafkaWireClient):
                 continue
             out.append((tname, pidx, pos, records, next_off))
             pos_key = (tname, pidx)
-            if next_off > self._positions[pos_key]:
-                self._positions[pos_key] = next_off
+            with self._lock:
+                if next_off > self._positions[pos_key]:
+                    self._positions[pos_key] = next_off
         return out
 
 

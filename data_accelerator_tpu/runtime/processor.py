@@ -310,6 +310,9 @@ def pack_from_matrix(
     backend ``jnp.asarray`` of the 64-byte-aligned pool matrix is a
     zero-copy view, which is exactly why the pool may only reuse a
     matrix after its batch has landed (PendingBatch slot release)."""
+    # dx-race: param matrix=pool
+    # dx-race: allow-zero-copy THE designed pooled zero-copy ingest site;
+    # lifetime pinned by the PendingBatch owner-handoff
     return PackedRaw(
         jnp.asarray(matrix) if to_device else matrix, tuple(layout)
     )
@@ -633,6 +636,13 @@ class FlowProcessor:
         self.debug_tracer_leaks = (
             dbg_conf.get_or_else("tracerleaks", "false") or ""
         ).lower() == "true"
+        # process.debug.buffersanitizer arms the dynamic half of the
+        # DX8xx buffer-lifetime defense (runtime/sanitizer.py): released
+        # pool slots are poisoned, sink payloads and window checkpoints
+        # scanned for leakage; hits fire runtime DX805
+        from .sanitizer import from_conf as _sanitizer_from_conf
+
+        self.buffer_sanitizer = _sanitizer_from_conf(dbg_conf)
         # on_interval failures skipped this/previous batches, drained
         # into the DATAX-<flow>:UdfRefreshError metric at collect()
         self.udf_refresh_errors = 0
@@ -1174,6 +1184,8 @@ class FlowProcessor:
         ]
 
     def _init_device_state(self):
+        # dx-race: single-threaded init/reset path — runs before the host
+        # starts the landing worker (or with it quiesced on LQ reset)
         self.window_buffers: Dict[str, WindowBuffers] = {}
         target_caps = {s.target: s.capacity for s in self.specs.values()}
         for table, slots in self.ring_slots.items():
@@ -1278,6 +1290,7 @@ class FlowProcessor:
                 return False
             if set(saved["cols"]) != set(buf.cols) or any(
                 saved["cols"][c].shape != buf.cols[c].shape
+                # dx-race: allow-zero-copy dtype probe only — no element read
                 or saved["cols"][c].dtype != np.asarray(buf.cols[c]).dtype
                 for c in buf.cols
             ):
@@ -1303,10 +1316,13 @@ class FlowProcessor:
                 )
                 for t, b in restored.items()
             }
-        self.window_buffers = restored
-        self._slot_counter = int(snap.get("slot_counter", 0))
-        base = snap.get("base_ms")
-        self._base_ms = int(base) if base is not None else None
+        # publish atomically under the device-state lock: a checkpoint on
+        # the landing thread must never see half-swapped ring state
+        with self._device_state_lock:
+            self.window_buffers = restored
+            self._slot_counter = int(snap.get("slot_counter", 0))
+            base = snap.get("base_ms")
+            self._base_ms = int(base) if base is not None else None
         return True
 
     # -- partitioned window state (the rescale-handoff path) --------------
@@ -1750,6 +1766,8 @@ class FlowProcessor:
             pool is None or pool.n_rows != n_rows or pool.capacity != cap
         ):
             pool = PackedBufferPool(n_rows, cap)
+            # armed debug.buffersanitizer: released slots get poisoned
+            pool.sanitizer = self.buffer_sanitizer
             self._ingest_pools[spec.name] = pool
         col_rows = self._ingest_col_rows.get(spec.name)
         if col_rows is None:
@@ -1818,6 +1836,8 @@ class FlowProcessor:
             )
             mat[valid_row] = new_valid.astype(np.int32)
         pr = pack_from_matrix(mat, layout, to_device=to_device)
+        # dx-race: owner-handoff pool slot rides the PackedRaw into the
+        # PendingBatch, which releases it on land/abandon
         pr._ingest_pool = (pool, mat)
         return pr
 
@@ -1963,26 +1983,33 @@ class FlowProcessor:
         # whole-second base so device absolute-time math is exact
         new_base_ms = (batch_time_ms // 1000) * 1000
         if self._base_ms is None:
-            self._base_ms = new_base_ms
+            with self._device_state_lock:
+                self._base_ms = new_base_ms
         delta_ms = new_base_ms - self._base_ms
         if abs(delta_ms) > 2**31 - 1:
             # a restored checkpoint (or clock jump) more than ~24.8 days
             # out: every ring row is long past any window horizon, and
-            # the int32 rebase would overflow — start from clean rings
+            # the int32 rebase would overflow — start from clean rings.
+            # Published under the device-state lock so a checkpoint on
+            # the landing thread never snapshots mid-swap rings.
             target_caps = {s.target: s.capacity for s in self.specs.values()}
-            self.window_buffers = {
-                table: make_buffers(
-                    self.target_schemas[table], target_caps[table], slots
-                )
-                for table, slots in self.ring_slots.items()
-            }
+            with self._device_state_lock:
+                self.window_buffers = {
+                    table: make_buffers(
+                        self.target_schemas[table], target_caps[table], slots
+                    )
+                    for table, slots in self.ring_slots.items()
+                }
             delta_ms = 0
-        self._base_ms = new_base_ms
+        # the landing thread's checkpoint reads base/counter under this
+        # lock; writes pair with it so a snapshot is never torn
+        with self._device_state_lock:
+            self._base_ms = new_base_ms
+            counter = jnp.asarray(self._slot_counter, jnp.int32)
+            self._slot_counter += 1
 
         base_s = jnp.asarray(new_base_ms // 1000, jnp.int32)
         now_rel_ms = jnp.asarray(batch_time_ms - new_base_ms, jnp.int32)
-        counter = jnp.asarray(self._slot_counter, jnp.int32)
-        self._slot_counter += 1
 
         refdata_tables = {n: t for n, (_, t) in self.refdata.items()}
         # string-op dictionary tables: refreshed AFTER this batch's encode
@@ -2045,12 +2072,16 @@ class FlowProcessor:
         )
         # this batch's pooled ingest matrices: released by the handle
         # when the batch lands/abandons, never before the step is done
+        # dx-race: owner-handoff pool slots ride the PendingBatch; its
+        # collect/abandon path is the unique releaser
         handle._ingest_buffers = ingest_buffers
         # each staged slot is owned by THIS batch until its transfer
         # lands: record the handle's landed-event so the dispatch that
         # next rotates onto the slot knows whether donation is safe
         for key, parity in staged_slots:
             table, _ev = self._slots[key][parity]
+            # dx-race: owner-handoff slot ownership moves to this handle;
+            # _stage_output checks the landed event before re-donating
             self._slots[key][parity] = (table, handle._landed)
         # begin the device->host result copies NOW (async enqueue, free):
         # by the time collect() runs — typically one pipelined iteration
@@ -2820,6 +2851,14 @@ class PendingBatch:
             self._release_ingest()
             self._landed.set()
 
+        # armed sanitizer: every landed host table is scanned for
+        # sentinel leakage BEFORE materialization — a poisoned pool slot
+        # showing through a sink payload is the use-after-release the
+        # static pass (DX800/DX801) exists to prevent
+        if proc.buffer_sanitizer is not None:
+            for name, table in host_tables.items():
+                proc.buffer_sanitizer.scan_table(name, table)
+
         datasets: Dict[str, List[dict]] = {}
         with _trace_span("materialize"):
             for name, table in host_tables.items():
@@ -2948,6 +2987,10 @@ class PendingBatch:
         # synchronous wire cost of the batch tail (everything else
         # streams in the background)
         metrics["Sync_CountsBytes"] = float(counts.nbytes)
+        # sanitizer accounting: views guarded since the last collect,
+        # and (only when nonzero — silence is health) poison hits
+        if proc.buffer_sanitizer is not None:
+            metrics.update(proc.buffer_sanitizer.drain_metric_deltas())
         if proc.transfer_stats:
             for k, v in proc.transfer_stats.items():
                 metrics[f"Transfer_{k}_Count"] = float(v)

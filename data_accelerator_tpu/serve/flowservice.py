@@ -133,6 +133,17 @@ class FlowOperation:
 
         return analyze_flow_mesh(flow, chips=chips)
 
+    def validate_flow_race(self, flow: dict):
+        """The race tier of ``flow/validate`` (``race: true``): the
+        DX8xx buffer-lifetime/concurrency gate over the ENGINE modules
+        the flow would deploy onto (``runtime/``, ``lq/``, ``pilot/``)
+        — a provenance-lattice abstract interpretation of the runtime's
+        own source, cached per engine-source state. Same implementation
+        as the CLI's ``--race``; nothing executes."""
+        from ..analysis import analyze_flow_race
+
+        return analyze_flow_race(flow)
+
     def validate_flow_fleet(self, flow: dict, spec: Optional[dict] = None):
         """The fleet tier of ``flow/validate`` (``fleet: true``): the
         candidate flow is analyzed AS A SET with every currently

@@ -272,9 +272,13 @@ class DataXApi:
         ``--mesh``): DX7xx partition lints merged into the diagnostics
         plus a ``mesh`` section carrying the sharding plan (stage ->
         axis -> per-chip bytes -> ICI bytes); the same ``"chips": N``
-        body field sets the mesh size. ``"all": true`` runs every tier
-        in one call — one merged report, one ``schemaVersion``, the CI
-        single-invocation path."""
+        body field sets the mesh size. ``"race": true`` adds the
+        buffer-lifetime/concurrency tier (the CLI's ``--race``): the
+        DX8xx lints over the ENGINE modules the flow deploys onto,
+        merged into the diagnostics plus a ``race`` section (modules
+        analyzed, pinned zero-copy sites, owner handoffs). ``"all":
+        true`` runs every tier in one call — one merged report, one
+        ``schemaVersion``, the CI single-invocation path."""
         flow = body.get("flow") or body.get("gui")
         if flow is None and (body.get("flowName") or body.get("name")) \
                 and not body.get("process") and not body.get("input"):
@@ -290,8 +294,9 @@ class DataXApi:
         want_fleet = all_tiers or body.get("fleet")
         want_compile = all_tiers or body.get("compile")
         want_mesh = all_tiers or body.get("mesh")
+        want_race = all_tiers or body.get("race")
         if not (want_device or want_udfs or want_fleet or want_compile
-                or want_mesh):
+                or want_mesh or want_race):
             return report.to_dict()
         from ..analysis import (
             ChipCountError,
@@ -328,8 +333,12 @@ class DataXApi:
             self.flow_ops.validate_flow_mesh(flow, chips=chips)
             if want_mesh else None
         )
+        race = (
+            self.flow_ops.validate_flow_race(flow) if want_race else None
+        )
         return combined_report_dict(
-            report, device, udfs, fleet, compile_surface=comp, mesh=mesh
+            report, device, udfs, fleet, compile_surface=comp, mesh=mesh,
+            race=race,
         )
 
     def _flow_generate(self, body, query):

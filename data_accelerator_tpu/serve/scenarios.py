@@ -253,6 +253,11 @@ def _build_chaos_host(ctx, name: str, pilot: bool, depth: int = 2,
         "datax.job.process.transform": tpath,
         "datax.job.process.batchcapacity": "8",
         "datax.job.process.pipeline.depth": str(depth),
+        # every chaos drill runs with the buffer sanitizer armed: the
+        # crash/rescale/outage churn is the exact regime where an
+        # escaped pooled/donated view would surface, and the drills
+        # assert it stays silent (zero DX805 poison hits)
+        "datax.job.process.debug.buffersanitizer": "true",
         "datax.job.process.telemetry.tracefile": os.path.join(
             workdir, "trace.jsonl"
         ),
@@ -693,6 +698,9 @@ def _build_stateful_host(ctx, name: str, pilot: bool, depth: int,
         "datax.job.process.state.replicacount": str(replica_count),
         "datax.job.process.state.snapshoturl": ctx["store_url"],
         "datax.job.process.state.filteringest": "true",
+        # every drill runs with the DX805 buffer sanitizer armed: the
+        # rescale handoff churn must not leak a pooled/donated view
+        "datax.job.process.debug.buffersanitizer": "true",
         "datax.job.process.telemetry.tracefile": os.path.join(
             workdir, "trace.jsonl"
         ),

@@ -258,6 +258,17 @@ route("#/flow/", async (view, hash) => {
           h("td", { class: "mono" },
             (s.reshards || []).map((e) => e.table).join(", ") || "–"))))));
   };
+  const renderRaceGate = (rc) => {
+    // race tier (flow/validate race: true): the DX8xx buffer-lifetime
+    // gate over the ENGINE the flow deploys onto — any error here is
+    // an engine bug, not a flow bug, so the summary line names the
+    // analyzed surface (merged DX8xx diagnostics render above)
+    if (!rc || !rc.analyzedFiles) return null;
+    return h("div", { class: "muted" },
+      `race gate: ${rc.analyzedFiles} engine module(s) analyzed — ` +
+      `${rc.allowedZeroCopySites} pinned zero-copy site(s), ` +
+      `${rc.ownerHandoffSites} owner handoff(s)`);
+  };
   const renderDiags = (r) => {
     diagBox.replaceChildren(
       h("div", { class: "muted" },
@@ -270,6 +281,7 @@ route("#/flow/", async (view, hash) => {
         d.span && d.span.line ? h("span", { class: "muted" }, ` line ${d.span.line}`) : null)),
       renderUdfSummary(r.udfs),
       renderCompileSurface(r.compile),
+      renderRaceGate(r.race),
       renderCostTable(r.device),
       renderShardingTable(r.mesh),
       renderPlacement(r.fleet));
@@ -277,7 +289,7 @@ route("#/flow/", async (view, hash) => {
   const validate = async () => {
     await save();
     // all: true = every analysis tier in one call (semantic + device +
-    // udfs + fleet + compile), one merged diagnostics list
+    // udfs + fleet + compile + mesh + race), one merged diagnostics list
     const r = await api("POST", "/api/flow/flow/validate",
       { flow: gui, all: true });
     renderDiags(r);

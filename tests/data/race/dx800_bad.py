@@ -1,0 +1,17 @@
+"""BAD twin — DX800: a pooled buffer VIEW escapes its guarded scope.
+
+The snapshot keeps a zero-copy reference to a pool matrix row; after
+the pool releases (and, under the sanitizer, poisons) the matrix, the
+"checkpoint" reads freed-for-reuse memory — the exact PR 13 bug shape.
+Ground truth: run tests/test_racecheck.py drives this against a real
+PackedBufferPool with the sanitizer armed and observes the poison hit.
+"""
+
+
+class WindowSnapshotter:
+    """Checkpoints one pooled ingest matrix row."""
+
+    def snapshot(self, matrix):
+        # dx-race: param matrix=pool
+        rows = matrix[0]
+        return {"rows": rows}

@@ -1,0 +1,14 @@
+"""CLEAN twin — DX800: the snapshot takes a REAL copy, so the pooled
+matrix can be released (and poisoned) without the checkpoint ever
+seeing it. Runs sanitizer-silent."""
+
+import numpy as np
+
+
+class WindowSnapshotter:
+    """Checkpoints one pooled ingest matrix row."""
+
+    def snapshot(self, matrix):
+        # dx-race: param matrix=pool
+        rows = np.array(matrix[0])
+        return {"rows": rows}

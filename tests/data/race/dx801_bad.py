@@ -1,0 +1,14 @@
+"""BAD twin — DX801: ``np.asarray`` of a pool buffer outside an
+annotated allowed-zero-copy site. The view itself stays local (no
+DX800), but the zero-copy is undeclared — the self-lint must pin every
+deliberate zero-copy site so a new one is a conscious decision."""
+
+import numpy as np
+
+
+class IngestProber:
+    def probe_dtype(self, pool):
+        mat = pool.acquire()
+        dt = np.asarray(mat).dtype
+        pool.release(mat)
+        return str(dt)

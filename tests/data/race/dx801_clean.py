@@ -1,0 +1,14 @@
+"""CLEAN twin — DX801: the same zero-copy probe, ANNOTATED. The
+marker pins the site: the view is read-only and dies before the pool
+can recycle the matrix."""
+
+import numpy as np
+
+
+class IngestProber:
+    def probe_dtype(self, pool):
+        mat = pool.acquire()
+        # dx-race: allow-zero-copy dtype probe only — no element read
+        dt = np.asarray(mat).dtype
+        pool.release(mat)
+        return str(dt)

@@ -1,0 +1,19 @@
+"""BAD twin — DX802: lockset violation. ``seek`` writes the position
+under the lock, ``advance`` writes it lock-free — the kafka_wire
+``_positions`` bug shape: whichever thread loses the race replays or
+skips records."""
+
+import threading
+
+
+class PositionTracker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.position = 0
+
+    def seek(self, offset):
+        with self._lock:
+            self.position = offset
+
+    def advance(self, n):
+        self.position = self.position + n

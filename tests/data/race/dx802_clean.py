@@ -1,0 +1,18 @@
+"""CLEAN twin — DX802: every write of the shared position takes the
+same lock; the lockset discipline holds."""
+
+import threading
+
+
+class PositionTracker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.position = 0
+
+    def seek(self, offset):
+        with self._lock:
+            self.position = offset
+
+    def advance(self, n):
+        with self._lock:
+            self.position = self.position + n

@@ -1,0 +1,10 @@
+"""BAD twin — DX803: an A/B transfer slot is re-donated into the
+jitted pack with NO land-ack check. If the slot's previous D2H copy is
+still streaming, XLA overwrites the bytes mid-transfer — torn output
+rows on the wire."""
+
+
+class OutputStager:
+    def stage(self, table):
+        slot = self._slots[0]
+        return self._jit_pack_slot(slot, table)

@@ -1,0 +1,12 @@
+"""CLEAN twin — DX803: the slot is only re-donated after its previous
+transfer's landed event acks (``is_set()``); an un-landed slot falls
+back instead of blocking — the engine's ``_stage_output`` discipline."""
+
+
+class OutputStager:
+    def stage(self, table):
+        prev = self._slots[0]
+        if not prev[1].is_set():
+            return None
+        slot = prev[0]
+        return self._jit_pack_slot(slot, table)

@@ -1,0 +1,11 @@
+"""BAD twin — DX804: a blocking device sync on a thread the pipeline
+model requires non-blocking. The dispatch loop's depth-N overlap is
+the whole performance model; one stray ``block_until_ready`` serializes
+the pipeline."""
+
+
+class DispatchLoop:
+    def enqueue(self, handle):
+        # dx-race: non-blocking
+        handle.counts.block_until_ready()
+        return handle

@@ -1,0 +1,12 @@
+"""CLEAN twin — DX804: the non-blocking path only enqueues and polls;
+the sync happens elsewhere (the collect/landing half, which is allowed
+to block)."""
+
+
+class DispatchLoop:
+    def enqueue(self, handle):
+        # dx-race: non-blocking
+        if handle.ready:
+            return handle
+        self.pending.append(handle)
+        return None

@@ -153,6 +153,7 @@ def test_every_registered_code_has_a_golden_fixture():
     from test_compilecheck import COMPILE_GOLDEN
     from test_fleetcheck import FLEET_GOLDEN
     from test_meshcheck import MESH_GOLDEN
+    from test_racecheck import RACE_CODES
 
     assert (
         {g[1] for g in GOLDEN}
@@ -161,6 +162,7 @@ def test_every_registered_code_has_a_golden_fixture():
         | {g[2] for g in FLEET_GOLDEN}
         | {g[1] for g in COMPILE_GOLDEN}
         | {g[1] for g in MESH_GOLDEN}
+        | set(RACE_CODES)
     ) == set(CODES)
 
 
@@ -426,6 +428,16 @@ def test_json_reports_pin_schema_version_and_keys(tmp_path):
         "perChipBytes", "iciResultBytes", "iciWireBytes", "reshards",
         "loweredBytes", "detail",
     }
+
+    # race tier (schemaVersion 3: the engine buffer-lifetime gate)
+    out = json.loads(_run_cli(["--json", "--race", path]).stdout)
+    assert out["schemaVersion"] == REPORT_SCHEMA_VERSION
+    assert set(out) == base_keys | {"file", "race"}
+    assert set(out["race"]) == {
+        "flow", "analyzedFiles", "modules", "allowedZeroCopySites",
+        "ownerHandoffSites",
+    }
+    assert set(out["race"]["modules"][0]) == {"path", "functions"}
 
 
 def test_validate_endpoint_reports_carry_schema_version(flow_ops):

@@ -47,6 +47,13 @@ def _run(factory, pilot, tmp_path):
         f"{scenario.name} failed at step {result.failed_step}:\n"
         + "".join(s.error or "" for s in result.steps)
     )
+    # every drill runs with the buffer sanitizer armed
+    # (_build_chaos_host): the fault churn must end with zero DX805
+    # poison hits — no pooled/donated view outlived its buffer
+    san = ctx["host"].processor.buffer_sanitizer
+    assert san is not None and san.poison_hits == 0, (
+        f"{scenario.name}: sanitizer hits {san.drain_events()}"
+    )
     return ctx, result
 
 

@@ -230,6 +230,18 @@ def test_state_partition_metrics_are_registered():
     assert not MetricName.is_runtime_metric("State_Partition_Bogus")
 
 
+def test_sanitizer_metrics_are_registered():
+    """The buffer sanitizer's series (runtime/sanitizer.py, drained at
+    collect and by the host checkpoint guard) resolve through the
+    registry; emission-side coverage is tests/test_racecheck.py."""
+    for m in (
+        "Sanitizer_GuardedViews_Count",
+        "Sanitizer_PoisonHit_Count",
+    ):
+        assert MetricName.is_runtime_metric(m), m
+    assert not MetricName.is_runtime_metric("Sanitizer_Bogus")
+
+
 def test_lq_serving_metrics_are_registered():
     """Every LQ_* / Latency-LQExec series the LiveQuery serving plane
     emits (lq/service.py export_metrics under DATAX-LiveQuery) resolves

@@ -299,6 +299,10 @@ def _depth_host(tmp_path, depth):
         "datax.job.process.transform": str(t),
         "datax.job.process.batchcapacity": "4",
         "datax.job.process.pipeline.depth": str(depth),
+        # the buffer sanitizer rides every recovery drill: crash/requeue
+        # churn at depth 2/4 is exactly where an escaped pooled view
+        # would surface, and the suite asserts it stays silent
+        "datax.job.process.debug.buffersanitizer": "true",
         "datax.job.output.Out.console.maxrows": "0",
     })
     src = SocketSource(port=0)
@@ -361,6 +365,11 @@ def test_depth_window_sink_failure_fifo_and_requeue(tmp_path, depth):
         assert host.batches_processed == 4
         all_ks = [k for _t, ks in sink.batches for k in ks]
         assert all_ks == list(range(16))  # no loss, no duplication
+        # the armed buffer sanitizer saw the whole failure/rerun cycle:
+        # zero poison hits means no pooled/donated view outlived its slot
+        san = host.processor.buffer_sanitizer
+        assert san is not None and san.poison_hits == 0
+        assert san.drain_events() == []
     finally:
         host.stop()
 
