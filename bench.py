@@ -909,6 +909,83 @@ def bench_livequery(seconds=None, tenants=8, sessions_per_tenant=4,
     }
 
 
+def bench_fleet_rollup(replicas=8, batches=12):
+    """Fleet telemetry plane acceptance block: the cost of the push-
+    based cross-replica rollup. A synthetic 8-replica fleet publishes
+    windowed frames (counters + per-stage histogram states + delivery
+    counts) through a live object store; the control-plane ``FleetView``
+    pulls and merges them. Published numbers are the per-frame wire
+    size, the publish (store put) latency, and the full-fleet merge
+    latency — the telemetry overhead a replica and the control plane
+    each pay. ``conserved`` is the acceptance bit: the DX54x audit over
+    the synthetic fleet must balance exactly."""
+    from data_accelerator_tpu.obs.fleetview import FleetView
+    from data_accelerator_tpu.obs.histogram import HistogramRegistry
+    from data_accelerator_tpu.obs.publisher import TelemetryFramePublisher
+    from data_accelerator_tpu.serve.objectstore import ObjectStoreServer
+
+    store = ObjectStoreServer(port=0).start()  # in-memory
+    url = f"objstore://127.0.0.1:{store.port}/bench/fleet"
+    try:
+        frame_bytes, publish_ms = [], []
+        for index in range(1, replicas + 1):
+            pub = TelemetryFramePublisher(
+                url,
+                flow="BenchFleet",
+                replica=f"r{index}",
+                replica_index=index,
+                replica_count=replicas,
+                window_s=0.0,  # publish every batch: worst-case cadence
+                histograms=HistogramRegistry(),
+            )
+            for b in range(batches):
+                for stage in ("decode", "process", "collect"):
+                    # deterministic spread; merge exactness is the unit
+                    # suite's job, this block only prices the plumbing
+                    pub.histograms.observe(
+                        "BenchFleet", stage, 1.0 + (b * 7 + index) % 23
+                    )
+                pub.record_batch(
+                    {
+                        "Input_default_Events_Count": 256.0,
+                        "Output_Out_Events_Count": 256.0,
+                        "Batch_ProcessedMs": 9.5,
+                        "DataXProcessedInput_Count": 256.0,
+                    },
+                    consumed={("default", index): (b * 256, (b + 1) * 256)},
+                    batch_time_ms=1_000 + b,
+                )
+                frame_bytes.append(pub.last_frame_bytes)
+                publish_ms.append(pub.last_publish_ms)
+            assert pub.flush(final=True)
+            assert pub.publish_errors == 0
+
+        view = FleetView.from_url(url)
+        t0 = time.perf_counter()
+        n_frames = view.refresh()
+        merge_ms = (time.perf_counter() - t0) * 1000.0
+        audit = view.audit("BenchFleet")
+        fm = view.fleet_metrics("BenchFleet")
+        expected = 256.0 * replicas * batches
+        return {
+            "replicas": replicas,
+            "frames": n_frames,
+            "frame_bytes": round(sum(frame_bytes) / len(frame_bytes)),
+            "publish_ms": round(sum(publish_ms) / len(publish_ms), 3),
+            "merge_ms": round(merge_ms, 1),
+            "decode_errors": view.decode_errors,
+            # the acceptance bit: the rollup balances — summed ingest
+            # equals both the audit's emit side and the merged counter
+            "conserved": bool(
+                audit["conserved"]
+                and audit["counts"] == {"DX540": 0, "DX541": 0, "DX542": 0}
+                and fm["counters"]["Input_default_Events_Count"] == expected
+            ),
+        }
+    finally:
+        store.stop()
+
+
 def regression_gate(current: dict, tolerance: float = 0.10):
     """Trajectory gate: compare this run against the latest committed
     BENCH_r*.json and emit a ``regression`` block — events/s and p99
@@ -996,6 +1073,11 @@ def regression_gate(current: dict, tolerance: float = 0.10):
     # band fails like an events/s drop
     d_lq_qps = nested_delta("livequery", "kernel_qps")
     d_lq_p99 = nested_delta("livequery", "p99_exec_ms")
+    # fleet telemetry gates: per-frame publish cost on the replica and
+    # full-fleet merge cost on the control plane — a >band worsening of
+    # either means the observability plane itself got expensive
+    d_fleet_pub = nested_delta("fleet_rollup", "publish_ms")
+    d_fleet_merge = nested_delta("fleet_rollup", "merge_ms")
     # cold-start gate: warm time-to-first-batch is the restart/
     # preemption-recovery promise — a >band worsening (or warm no
     # longer beating cold at all) fails like an events/s drop
@@ -1020,6 +1102,12 @@ def regression_gate(current: dict, tolerance: float = 0.10):
         or (bool(cs_cur) and not cs_cur.get("warm_below_cold", True))
         or (d_lq_qps is not None and d_lq_qps < -tolerance)
         or (d_lq_p99 is not None and d_lq_p99 > tolerance)
+        or (d_fleet_pub is not None and d_fleet_pub > tolerance)
+        or (d_fleet_merge is not None and d_fleet_merge > tolerance)
+        or (
+            bool(current.get("fleet_rollup"))
+            and not current["fleet_rollup"].get("conserved", True)
+        )
     )
     return {
         "baseline": os.path.basename(latest),
@@ -1030,6 +1118,8 @@ def regression_gate(current: dict, tolerance: float = 0.10):
         "warm_first_batch_delta": d_warm_first,
         "lq_kernel_qps_delta": d_lq_qps,
         "lq_p99_exec_delta": d_lq_p99,
+        "fleet_publish_delta": d_fleet_pub,
+        "fleet_merge_delta": d_fleet_merge,
         "tolerance": tolerance,
         "regressed": regressed,
     }
@@ -1216,6 +1306,10 @@ def main():
         # exec latency under multi-tenant open-loop load, published
         # beside the streaming events/s headline (ROADMAP item 3)
         "livequery": bench_livequery(),
+        # fleet telemetry plane cost: per-frame publish + full-fleet
+        # merge latency over a synthetic 8-replica fleet, with the
+        # DX54x conservation audit as the acceptance bit
+        "fleet_rollup": bench_fleet_rollup(),
     }
     reg = regression_gate(result)
     if reg is not None:

@@ -77,6 +77,21 @@ class MetricName:
     MetricSinkPrefix = "Sink_"
     LatencyPrefix = "Latency-"
 
+    # fleet telemetry plane (obs/publisher.py + obs/fleetview.py):
+    # publisher self-metrics and aggregator-side counters, referenced
+    # by name from both modules so the emit sites and the registry
+    # cannot drift
+    FLEET_FRAMES = "Fleet_Frames_Count"
+    FLEET_FRAME_BYTES = "Fleet_Frame_Bytes"
+    FLEET_FRAME_PUBLISH_MS = "Fleet_FramePublish_Ms"
+    FLEET_FRAME_PUBLISH_ERROR = "Fleet_FramePublishError_Count"
+    FLEET_FRAME_DECODE_ERROR = "Fleet_FrameDecodeError_Count"
+    FLEET_MERGE_LATENCY_MS = "Fleet_MergeLatency_Ms"
+    # delivery-conservation audit counters (obs/fleetview.py DX54x)
+    DELIVERY_LOSS = "Conformance_Delivery_Loss_Count"
+    DELIVERY_DUPLICATE = "Conformance_Delivery_Duplicate_Count"
+    DELIVERY_STALE_REPLICA = "Conformance_Delivery_StaleReplica_Count"
+
     # canonical per-batch stage names (span names == histogram stages ==
     # the <stage> of Latency-<stage> metrics, modulo capitalization),
     # plus the LiveQuery serving plane's end-to-end execute stage
@@ -249,6 +264,20 @@ class MetricName:
         r"Fleet_Chip[0-9]+_(HbmBytes|Utilization)",
         r"Fleet_AdmissionRejected_Count",
         r"Placement_Replans_Count",
+        # fleet telemetry plane (obs/publisher.py frames published /
+        # last frame bytes / publish latency / failed publishes, and
+        # obs/fleetview.py corrupt frames skipped, cross-replica merge
+        # latency, replica liveness gauges)
+        r"Fleet_Frames_Count",
+        r"Fleet_Frame_Bytes",
+        r"Fleet_FramePublish_Ms",
+        r"Fleet_FramePublishError_Count",
+        r"Fleet_FrameDecodeError_Count",
+        r"Fleet_MergeLatency_Ms",
+        r"Fleet_(Replicas|StaleReplicas)_Count",
+        # delivery-conservation audit (obs/fleetview.py DX540/541/542):
+        # cumulative audit findings per flow over the merged lineage
+        r"Conformance_Delivery_(Loss|Duplicate|StaleReplica)_Count",
         # LiveQuery serving plane (lq/service.py, exported under the
         # DATAX-LiveQuery app): live session/tenant gauges, completed
         # execute QPS over a trailing 10 s window, queued-not-yet-

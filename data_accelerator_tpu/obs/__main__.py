@@ -28,6 +28,18 @@ reads the flight recorder's span records; with ``--aggregate`` it
 renders the flame table — stage -> count / total ms / p50 / p99 —
 the offline rollup of the same per-stage decomposition the live
 histograms serve.
+
+``python -m data_accelerator_tpu.obs fleet [--url U] [--flow F]
+[--output O] [--json]`` queries the control plane's fleet telemetry
+rollup (``GET /fleet/metrics`` / ``/fleet/flows/<flow>``,
+obs/fleetview.py): merged counters and histograms, per-replica status,
+replica lineage, and the DX54x delivery-conservation audit.
+
+``obs trace ... --stitch`` additionally groups the rendered spans by
+the ``replica`` tag each host stamps on its batch spans, following the
+flow's replica lineage across a rescale/handoff as one continuous
+cross-replica tree (segments ordered by first activity, handoff
+connectors between them).
 """
 
 from __future__ import annotations
@@ -133,11 +145,48 @@ def format_tree(spans: List[dict]) -> str:
     return "\n".join(lines)
 
 
+def _replica_of_trace(tspans: List[dict]) -> Optional[str]:
+    """The replica tag of a trace: hosts publishing to the fleet plane
+    stamp ``replica=<name>`` on their batch spans (runtime/host.py), so
+    any tagged span identifies the segment."""
+    for s in tspans:
+        rep = (s.get("properties") or {}).get("replica")
+        if rep:
+            return str(rep)
+    return None
+
+
+def stitch_lineage(spans: List[dict],
+                   trace_ids: List[str]) -> List[tuple]:
+    """Group traces into replica lineage segments, ordered by first
+    activity — the succession order a rescale handoff produces.
+    Returns ``(replica, [trace ids])`` pairs; untagged traces land in a
+    single ``(none)`` segment."""
+    by_trace: Dict[str, List[dict]] = {}
+    for s in spans:
+        if s.get("trace") in trace_ids:
+            by_trace.setdefault(s["trace"], []).append(s)
+    segments: Dict[str, List[str]] = {}
+    first_ts: Dict[str, float] = {}
+    for tid, tspans in by_trace.items():
+        rep = _replica_of_trace(tspans) or "(none)"
+        segments.setdefault(rep, []).append(tid)
+        ts = min(float(s.get("startTs") or 0) for s in tspans)
+        first_ts[rep] = min(first_ts.get(rep, ts), ts)
+        for lst in segments.values():
+            lst.sort(key=lambda t: min(
+                float(s.get("startTs") or 0) for s in by_trace[t]
+            ))
+    return sorted(segments.items(), key=lambda kv: first_ts[kv[0]])
+
+
 def cmd_trace(args) -> int:
     spans = load_spans(args.file)
     if not spans:
         print(f"no spans found in {args.file}", file=sys.stderr)
         return 2
+    if getattr(args, "stitch", False):
+        return _trace_stitched(spans, args)
     trace_ids = find_traces(spans, args.batch_id)
     if not trace_ids:
         roots = sorted(
@@ -160,6 +209,44 @@ def cmd_trace(args) -> int:
             continue
         print(f"trace {tid} ({len(tspans)} span(s))")
         print(format_tree(tspans))
+    return 0
+
+
+def _trace_stitched(spans: List[dict], args) -> int:
+    """One continuous cross-replica tree: every trace matching
+    ``batch_id`` — or, when the id is ``all``, every replica-tagged
+    trace in the recorder — grouped into lineage segments."""
+    if args.batch_id == "all":
+        trace_ids = []
+        for s in spans:
+            if (s.get("properties") or {}).get("replica") \
+                    and s["trace"] not in trace_ids:
+                trace_ids.append(s["trace"])
+    else:
+        trace_ids = find_traces(spans, args.batch_id)
+    if not trace_ids:
+        print(f"no trace for {args.batch_id!r} to stitch",
+              file=sys.stderr)
+        return 1
+    segments = stitch_lineage(spans, trace_ids)
+    if args.json:
+        print(json.dumps(
+            [{"replica": rep, "traces": tids} for rep, tids in segments],
+            indent=1,
+        ))
+        return 0
+    print(f"replica lineage — {len(segments)} segment(s), "
+          f"{len(trace_ids)} trace(s)")
+    for i, (rep, tids) in enumerate(segments):
+        if i:
+            print("└→ handoff")
+        nspans = sum(1 for s in spans if s.get("trace") in tids)
+        print(f"■ replica {rep} ({len(tids)} trace(s), {nspans} span(s))")
+        for tid in tids:
+            tspans = [s for s in spans if s.get("trace") == tid]
+            print(f"  trace {tid}")
+            for line in format_tree(tspans).splitlines():
+                print(f"    {line}")
     return 0
 
 
@@ -313,6 +400,76 @@ def cmd_profile(args) -> int:
     return 0
 
 
+def cmd_fleet(args) -> int:
+    import urllib.parse
+    import urllib.request
+
+    base = args.url.rstrip("/")
+    if args.flow:
+        url = f"{base}/fleet/flows/{urllib.parse.quote(args.flow)}"
+        if args.output:
+            url += "?" + urllib.parse.urlencode({"output": args.output})
+    else:
+        url = f"{base}/fleet/metrics"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            payload = json.loads(r.read() or b"{}")
+    except OSError as e:
+        print(f"cannot reach {url}: {e}", file=sys.stderr)
+        return 2
+    payload = payload.get("result", payload)
+    if args.json:
+        print(json.dumps(payload, indent=1, default=str))
+        return 0
+    if not args.flow:
+        flows = payload.get("flows") or {}
+        print(f"fleet — {len(flows)} flow(s), "
+              f"decode errors {payload.get('decodeErrors', 0)}, "
+              f"last merge {payload.get('mergeMs', 0)} ms")
+        for name in sorted(flows):
+            f = flows[name]
+            reps = f.get("replicas") or {}
+            statuses = [r.get("status") for r in reps.values()]
+            counts = (f.get("audit") or {}).get("counts") or {}
+            bad = " ".join(
+                f"{c}x{n}" for c, n in sorted(counts.items()) if n
+            )
+            print(f"  {name:<24} replicas={len(reps)} "
+                  f"live={statuses.count('live')} "
+                  f"stale={statuses.count('stale')} "
+                  f"completed={statuses.count('completed')} "
+                  f"alerts={len(f.get('alerts') or [])} "
+                  f"audit={bad or 'conserved'}")
+        return 0
+    print(f"fleet flow {payload.get('flow')}")
+    reps = payload.get("replicas") or {}
+    for name in sorted(reps):
+        r = reps[name]
+        print(f"  {name:<20} {r.get('status'):<10} "
+              f"frames={r.get('frames', 0)} batches={r.get('batches', 0)} "
+              f"windows={r.get('windows')}")
+    hists = payload.get("histograms") or {}
+    for stage in sorted(hists):
+        hh = hists[stage]
+        print(f"  {stage:<20} n={hh.get('count')} p50={hh.get('p50')}ms "
+              f"p95={hh.get('p95')}ms p99={hh.get('p99')}ms")
+    lineage = payload.get("lineage") or []
+    if lineage:
+        print("  lineage: " + " -> ".join(
+            str(seg.get("replica")) for seg in lineage
+        ))
+    audit = payload.get("audit") or {}
+    mark = "conserved" if audit.get("conserved") else "NOT CONSERVED"
+    print(f"  delivery: ingested={audit.get('ingested')} "
+          f"emitted={audit.get('emitted')} [{mark}]")
+    for e in audit.get("events") or []:
+        print(f"   {e.get('code')}: {e.get('name')} "
+              f"{e.get('description') or ''}")
+    for a in payload.get("alerts") or []:
+        print(f"   firing {a.get('severity') or 'warn'}: {a.get('name')}")
+    return 1 if (audit.get("events") or payload.get("alerts")) else 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m data_accelerator_tpu.obs",
@@ -331,6 +488,12 @@ def main(argv=None) -> int:
              "or ./telemetry.jsonl)",
     )
     tp.add_argument("--json", action="store_true", help="raw span JSON")
+    tp.add_argument(
+        "--stitch", action="store_true",
+        help="group traces into replica lineage segments (the replica "
+             "tag hosts stamp on batch spans); batch_id 'all' stitches "
+             "every tagged trace in the recorder",
+    )
     ap = sub.add_parser(
         "alerts", help="show a host's alert rules and firing set, or "
                        "validate a rules file"
@@ -377,6 +540,23 @@ def main(argv=None) -> int:
         help="capture window in seconds (default 5)",
     )
     pp.add_argument("--json", action="store_true", help="raw JSON payload")
+    fp = sub.add_parser(
+        "fleet", help="cross-replica telemetry rollup from the control "
+                      "plane (GET <url>/fleet/metrics)"
+    )
+    fp.add_argument(
+        "--url", default="http://127.0.0.1:5000",
+        help="control-plane base URL (default http://127.0.0.1:5000)",
+    )
+    fp.add_argument(
+        "--flow", help="drill into one flow "
+                       "(GET <url>/fleet/flows/<flow>)",
+    )
+    fp.add_argument(
+        "--output", help="audit this output's emitted counts instead "
+                         "of the busiest one (with --flow)",
+    )
+    fp.add_argument("--json", action="store_true", help="raw JSON payload")
     args = parser.parse_args(argv)
     if args.cmd == "trace":
         return cmd_trace(args)
@@ -386,6 +566,8 @@ def main(argv=None) -> int:
         return cmd_spans(args)
     if args.cmd == "profile":
         return cmd_profile(args)
+    if args.cmd == "fleet":
+        return cmd_fleet(args)
     return 2
 
 

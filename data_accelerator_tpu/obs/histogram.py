@@ -127,6 +127,78 @@ class LatencyHistogram:
             "sum_ms": s,
         }
 
+    def to_state(self) -> Dict[str, object]:
+        """Full serializable state: per-bucket (non-cumulative) counts
+        plus the raw sample window. The fleet telemetry frame carries
+        this shape (obs/publisher.py) so a control-plane merge is exact
+        — both the bucket counts AND the window percentiles survive the
+        wire (``from_state`` -> ``merge`` round-trip)."""
+        with self._lock:
+            return {
+                "buckets": list(self.buckets_ms),
+                "counts": list(self._counts),
+                "count": self.count,
+                "sumMs": self.sum_ms,
+                "window": list(self._window),
+            }
+
+    @classmethod
+    def from_state(cls, state: Dict[str, object]) -> "LatencyHistogram":
+        """Rebuild a histogram from ``to_state()`` output. The window
+        cap grows to hold every carried sample, so deserialization
+        never evicts."""
+        buckets = tuple(float(b) for b in state["buckets"])
+        window = [float(v) for v in state.get("window") or []]
+        h = cls(buckets, window=max(DEFAULT_WINDOW, len(window)))
+        counts = [int(c) for c in state["counts"]]
+        if len(counts) != len(buckets) + 1:
+            raise ValueError(
+                f"bucket/count shape mismatch: {len(counts)} counts for "
+                f"{len(buckets)} bounds"
+            )
+        h._counts = counts
+        h.count = int(state["count"])
+        h.sum_ms = float(state.get("sumMs", state.get("sum_ms", 0.0)))
+        h._window = window
+        h._window_ids = [None] * len(window)
+        return h
+
+    def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":
+        """Exact merge of two fixed-bucket histograms: element-wise
+        bucket-count addition plus a UNION of the raw sample windows,
+        returned as a new histogram (neither input is mutated).
+
+        Requires identical bucket bounds — cross-replica aggregation
+        only makes sense over one shared geometry (every host uses
+        DEFAULT_BUCKETS_MS unless conf'd otherwise). The merged window
+        cap is the sum of both inputs' caps, so no sample is evicted:
+        ``merged.percentile(q)`` equals a percentile computed over the
+        concatenated observations, and the operation is associative and
+        commutative (tested in tests/test_fleetview.py)."""
+        if self.buckets_ms != other.buckets_ms:
+            raise ValueError(
+                f"cannot merge histograms with different buckets: "
+                f"{self.buckets_ms} != {other.buckets_ms}"
+            )
+        # lock ordering by id() so concurrent a.merge(b) / b.merge(a)
+        # cannot deadlock
+        first, second = sorted((self, other), key=id)
+        with first._lock, second._lock:
+            merged = LatencyHistogram(
+                self.buckets_ms,
+                window=self._window_cap + other._window_cap,
+            )
+            merged._counts = [
+                a + b for a, b in zip(self._counts, other._counts)
+            ]
+            merged.count = self.count + other.count
+            merged.sum_ms = self.sum_ms + other.sum_ms
+            merged._window = list(self._window) + list(other._window)
+            merged._window_ids = (
+                list(self._window_ids) + list(other._window_ids)
+            )
+        return merged
+
 
 class HistogramRegistry:
     """(flow, stage) -> LatencyHistogram, lazily created.
@@ -148,6 +220,12 @@ class HistogramRegistry:
             if h is None:
                 h = self._hists[key] = LatencyHistogram(self.buckets_ms)
             return h
+
+    def put(self, flow: str, stage: str, hist: LatencyHistogram) -> None:
+        """Install a pre-built histogram (the fleet view's merged
+        cross-replica histograms land here, obs/fleetview.py)."""
+        with self._lock:
+            self._hists[(flow, stage)] = hist
 
     def observe(
         self, flow: str, stage: str, ms: float,

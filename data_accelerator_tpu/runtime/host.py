@@ -19,7 +19,7 @@ import tempfile
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from ..constants import MetricName
 from ..core.config import SettingDictionary, SettingNamespace
@@ -160,6 +160,18 @@ class StreamingHost:
             store=self.metric_logger.store,
             histograms=HISTOGRAMS,
             health=self.health,
+        )
+        # fleet telemetry plane (obs/publisher.py): when
+        # process.fleet.publishurl is conf'd, every batch finish folds
+        # into a windowed frame shipped to the shared objstore for the
+        # control plane's FleetView rollup. None = per-process only.
+        from ..obs.publisher import TelemetryFramePublisher
+
+        self.fleet_publisher = TelemetryFramePublisher.from_conf(
+            dict_,
+            flow=dict_.get_job_name(),
+            metric_logger=self.metric_logger,
+            histograms=HISTOGRAMS,
         )
         # machine-profile calibration (obs/calibrate.py): ~100 ms of
         # jit micro-probes, process-cached and persisted/shared like
@@ -692,11 +704,21 @@ class StreamingHost:
         # alert evaluation AFTER the store flush so window aggregates
         # include this batch; the firing set rides the health payload
         # (readyz) and the Alerts_Firing series
+        firing: List[dict] = []
         if self.alerts is not None:
             firing = self.alerts.evaluate()
             self.health.record_alerts(firing)
             self.metric_logger.send_metric(
                 "Alerts_Firing", float(len(firing)), batch_time_ms
+            )
+        # fleet telemetry frame accumulation (obs/publisher.py): the
+        # acked batch's metric deltas + consumed offset ranges fold
+        # into the open window; record_batch is fail-open and
+        # thread-safe (this tail may run on the landing thread)
+        if self.fleet_publisher is not None:
+            self.fleet_publisher.record_batch(
+                metrics, consumed, batch_time_ms,
+                health=self.health.health(), alerts=firing,
             )
         logger.info(
             "batch %d: %s",
@@ -768,6 +790,11 @@ class StreamingHost:
         """Dispatch under the batch's trace, marking the dispatch-done
         instant the later device-step span measures from."""
         trace.add(batchTime=batch_time_ms)
+        if self.fleet_publisher is not None:
+            # replica identity on every batch root span: what lets
+            # `obs trace --stitch` group a shared flight recorder's
+            # spans into the flow's cross-replica lineage segments
+            trace.add(replica=self.fleet_publisher.replica)
         self.telemetry.batch_begin(batch_time_ms)
         with trace.activate(), tracing.span("dispatch"):
             handle = self.processor.dispatch_batch(raw, batch_time_ms)
@@ -996,6 +1023,11 @@ class StreamingHost:
             self._settle_landings()
             self._landing_pool.shutdown(wait=True)
             self._landing_pool = None
+        if self.fleet_publisher is not None:
+            # ship the tail window with the final drain marker — the
+            # fleet view's clean-shutdown signal (a replica that dies
+            # before this goes DX542-stale instead)
+            self.fleet_publisher.flush(final=True)
         if self.obs_server is not None:
             self.obs_server.stop()
             self.obs_server = None

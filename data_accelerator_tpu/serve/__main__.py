@@ -105,9 +105,21 @@ def main(argv=None):
         runtime_storage = ObjectRuntimeStorage(
             client, scratch_dir=f"{root}/scratch"
         )
+        # fleet telemetry plane: jobs publish windowed frames into the
+        # same store; the control plane aggregates them (FleetView)
+        # behind GET /fleet/metrics and the website's /metrics rollup
+        from ..obs.fleetview import FleetView
+
+        fleet_view = FleetView(client=ObjectStoreClient(endpoint))
+        env_tokens["fleetPublishUrl"] = (
+            f"objstore://{endpoint.split('://', 1)[-1]}/dxtpu"
+        )
+        log.info("fleet telemetry plane: frames -> %s",
+                 env_tokens["fleetPublishUrl"])
     else:
         design_storage = LocalDesignTimeStorage(f"{root}/design")
         runtime_storage = LocalRuntimeStorage(f"{root}/runtime")
+        fleet_view = None
 
     job_client = None
     if args.get("jobclient", "local") != "local":
@@ -154,9 +166,13 @@ def main(argv=None):
             runtime_storage.resolve("livequery"), "compilecache"
         )),
     )
+    if fleet_view is not None:
+        # job-registry records carry the authoritative partition map;
+        # trace lineage stitching prefers them over frame ordering
+        fleet_view.lineage_fn = flow_ops.jobs.job_lineage
     api = DataXApi(
         flow_ops, require_roles=args.get("roles", "false") == "true",
-        tracer=tracer, livequery=livequery,
+        tracer=tracer, livequery=livequery, fleet=fleet_view,
     )
     service = DataXApiService(api, port=port)
     service.start()
@@ -205,7 +221,7 @@ def main(argv=None):
                 log.warning("gateway enabled but no webtoken= given; "
                             "website API calls will be unauthenticated")
         else:
-            web = WebsiteServer(api=api, port=web_port)
+            web = WebsiteServer(api=api, port=web_port, fleet=fleet_view)
         web.start()
         parts.append(web)
         log.info("website on :%d", web.port)
@@ -216,6 +232,7 @@ def main(argv=None):
             flow_ops,
             interval_s=float(args["scheduler"]),
             replanner=flow_ops.placement,
+            fleet_view=fleet_view,
         )
         sched.start()
         parts.append(sched)

@@ -713,6 +713,14 @@ class RuntimeConfigGeneration:
                 # renders the whole cross-process tree from one file)
                 extra["datax.job.process.telemetry.tracefile"] = str(
                     jt.get("telemetryTraceFile"))
+            if jt.get("fleetPublishUrl"):
+                # fleet telemetry plane (obs/publisher.py): spawned
+                # hosts publish windowed frames to the control plane's
+                # shared objstore so FleetView can roll them up — the
+                # env-token wiring serve/__main__ sets when an object
+                # store is configured
+                extra["datax.job.process.fleet.publishurl"] = str(
+                    jt.get("fleetPublishUrl"))
             if ctx.get("conformance_json"):
                 extra["datax.job.process.conformance.model"] = (
                     ctx["conformance_json"])

@@ -788,6 +788,45 @@ class JobOperation:
         out.sort(key=lambda r: r.get("replicaIndex") or 0)
         return out
 
+    def job_lineage(self, flow: str) -> List[dict]:
+        """A flow's replica lineage for the fleet telemetry plane
+        (obs/fleetview.py ``lineage_fn``): the base record plus EVERY
+        replica record in the registry — stopped replicas included,
+        they are the history a cross-replica trace stitches over — in
+        (replicaIndex, name) order with the base's authoritative
+        ``statePartitionMap`` attached to each entry. Returns [] for
+        unknown flows so the fleet view falls back to frame-derived
+        lineage."""
+        base = None
+        replicas = []
+        for r in self.registry.get_all():
+            if r.get("name") == flow or (
+                r.get("flow") == flow and not r.get("replicaOf")
+            ):
+                base = r
+            elif r.get("replicaOf") == flow or (
+                r.get("flow") == flow and r.get("replicaOf")
+            ):
+                replicas.append(r)
+        if base is None and not replicas:
+            return []
+        pmap = (base or {}).get("statePartitionMap") or {}
+        replicas.sort(
+            key=lambda r: (r.get("replicaIndex") or 0, r.get("name") or "")
+        )
+        out = []
+        for rec in ([base] if base else []) + replicas:
+            idx = rec.get("replicaIndex") or 1
+            out.append({
+                "replica": rec.get("name"),
+                "replicaIndex": idx,
+                "replicaOf": rec.get("replicaOf"),
+                "state": rec.get("state"),
+                "statePartitionsOwned": rec.get("statePartitionsOwned"),
+                "partitionMap": pmap.get(str(idx)) or pmap.get(idx),
+            })
+        return out
+
     def _state_partition_plan(self, base: dict, replicas: int) -> dict:
         """Compute + persist the state-partition map of the new replica
         set: the admitted rescale plan now CARRIES the partition

@@ -67,6 +67,7 @@ class DataXApi:
         require_roles: bool = False,
         tracer: Optional[tracing.Tracer] = None,
         livequery: Optional[LiveQueryService] = None,
+        fleet=None,
     ):
         # control-plane request tracing: each dispatched route becomes a
         # `rest/<path>` trace whose id flows through job submit ->
@@ -108,6 +109,9 @@ class DataXApi:
                 compile_conf=compile_conf,
                 session_manager=self.livequery.sessions,
             )
+        # fleet telemetry rollup (obs/fleetview.py): /fleet/* routes
+        # read it; None = fleet plane not wired (404s explain why)
+        self.fleet = fleet
         self.schema_inference = SchemaInferenceManager(flow_ops.runtime)
         self.analyzer = SqlAnalyzer()
         self.codegen = CodegenEngine()
@@ -151,6 +155,11 @@ class DataXApi:
         r[("POST", "lq/session/close")] = (self._lq_session_close, False)
         r[("GET", "lq/sessions")] = (self._lq_sessions_list, False)
         r[("GET", "lq/stats")] = (self._lq_stats, False)
+        # fleet telemetry plane (obs/fleetview.py): the cross-replica
+        # rollup + lineage + DX54x delivery audit; /fleet/flows/<name>
+        # is rewritten onto the ?flow= form in dispatch()
+        r[("GET", "fleet/metrics")] = (self._fleet_metrics, False)
+        r[("GET", "fleet/flows")] = (self._fleet_flow, False)
 
     # -- dispatch --------------------------------------------------------
     def dispatch(
@@ -174,6 +183,13 @@ class DataXApi:
             "livequery",
         ) and (method.upper(), path) not in self.routes:
             path = rest
+        # path-parameter form of the fleet flow route: the route table
+        # is exact-match, so /fleet/flows/<flow> rewrites onto the
+        # query-param handler
+        if path.startswith("fleet/flows/"):
+            query = dict(query or {})
+            query["flow"] = [path[len("fleet/flows/"):]]
+            path = "fleet/flows"
         entry = self.routes.get((method.upper(), path))
         if entry is None:
             return 404, {"error": {"message": f"unknown route {method} {path}"}}
@@ -607,6 +623,35 @@ class DataXApi:
 
     def _lq_stats(self, body, query):
         return self.livequery.snapshot()
+
+    # -- fleet telemetry plane -------------------------------------------
+    def _require_fleet(self):
+        if self.fleet is None:
+            raise ApiError(
+                "fleet view not configured (run the control plane "
+                "with an object store so replicas have a frame plane)",
+                503,
+            )
+        return self.fleet
+
+    def _fleet_metrics(self, body, query):
+        fleet = self._require_fleet()
+        fleet.refresh()
+        return fleet.summary()
+
+    def _fleet_flow(self, body, query):
+        fleet = self._require_fleet()
+        flow = (query.get("flow") or [None])[0]
+        if not flow:
+            raise ApiError("flow name required: /fleet/flows/<flow>")
+        fleet.refresh()
+        if flow not in fleet.flows():
+            raise ApiError(f"no telemetry frames for flow {flow!r}", 404)
+        payload = fleet.fleet_metrics(flow)
+        output = (query.get("output") or [None])[0]
+        if output:
+            payload["audit"] = fleet.audit(flow, output=output)
+        return payload
 
 
 class DataXApiService:

@@ -698,6 +698,13 @@ def _build_stateful_host(ctx, name: str, pilot: bool, depth: int,
         "datax.job.process.state.replicacount": str(replica_count),
         "datax.job.process.state.snapshoturl": ctx["store_url"],
         "datax.job.process.state.filteringest": "true",
+        # fleet telemetry plane: every drill host publishes frames to
+        # the scenario's live store (windowseconds=0 -> one frame per
+        # batch), so the rescale lineage is observable as ONE fleet
+        # series across generations
+        "datax.job.process.fleet.publishurl": ctx["store_url"],
+        "datax.job.process.fleet.windowseconds": "0",
+        "datax.job.process.fleet.replica": f"g{gen}-r{replica_index}",
         # every drill runs with the DX805 buffer sanitizer armed: the
         # rescale handoff churn must not leak a pooled/donated view
         "datax.job.process.debug.buffersanitizer": "true",
@@ -936,6 +943,67 @@ def chaos_rescale_with_state(pilot: bool = False, depth: int = 2) -> Scenario:
         assert final == expected_final, (
             f"final state diverged: {final} != {expected_final}"
         )
+
+    @sc.step
+    def kill_replica_without_drain(ctx):
+        # one more generation, killed WITHOUT a drain: its frames are
+        # published (balanced ingested==emitted per acked batch) but
+        # the final-frame marker is suppressed — the fleet view must
+        # call that replica stale (DX542), not lost data (DX540)
+        from ..pilot.chaos import feed_socket
+
+        name = "RescaleStateP" if pilot else "RescaleStateB"
+        n_kill = 4
+        d = _build_stateful_host(ctx, name, pilot=False, depth=depth,
+                                 replica_index=1, replica_count=1, gen=3)
+        feed_socket(ctx["src"], _chaos_payload(_state_events(
+            n_pre + n_tail, n_pre + n_tail + n_kill
+        )), expect_events=n_kill)
+        _drain_group(ctx, [d], n_pre + n_tail + n_kill)
+        assert d.fleet_publisher is not None, "fleet publisher not armed"
+        assert d.fleet_publisher.frames_published >= 1, (
+            "killed replica never published a frame"
+        )
+        d.fleet_publisher.kill()
+        d.stop()
+        ctx["killed_replica"] = "g3-r1"
+
+    @sc.step
+    def assert_fleet_view(ctx):
+        # the control-plane aggregation over everything the lineage
+        # published: one continuous fleet series (every generation
+        # present), delivery conserved end to end, and exactly one
+        # stale replica — the undrained kill
+        from ..obs.fleetview import FleetView
+
+        name = "RescaleStateP" if pilot else "RescaleStateB"
+        view = FleetView(url=ctx["store_url"],
+                         now_fn=lambda: time.time() + 60.0)
+        assert view.refresh() >= 5, "fewer frames than replicas"
+        fm = view.fleet_metrics(name)
+        reps = fm["replicas"]
+        assert set(reps) == {
+            "g0-r1", "g1-r1", "g1-r2", "g2-r1", "g3-r1"
+        }, f"lineage not continuous: {sorted(reps)}"
+        lin = view.lineage(name)
+        assert [seg["replica"] for seg in lin][0] == "g0-r1", lin
+        assert len(lin) == 5, lin
+        audit = view.audit(name, output="Out")
+        counts = audit["counts"]
+        assert counts.get("DX540", 0) == 0, f"phantom loss: {audit}"
+        assert counts.get("DX541", 0) == 0, f"phantom dup: {audit}"
+        assert counts.get("DX542", 0) == 1, f"stale count: {audit}"
+        assert audit["conserved"], audit
+        total = n_pre + n_tail + 4
+        assert audit["ingested"] == total, (audit["ingested"], total)
+        assert audit["emitted"].get("Out") == total, audit["emitted"]
+        stale = [r for r, s in reps.items() if s["status"] == "stale"]
+        assert stale == [ctx["killed_replica"]], stale
+        done = [r for r, s in reps.items() if s["status"] == "completed"]
+        assert len(done) == 4, reps
+
+    @sc.step
+    def stop_store(ctx):
         ctx["store"].stop()
 
     if pilot:

@@ -71,11 +71,16 @@ class TimedScheduler:
         interval_s: float = 60.0,
         now_fn: Callable[[], float] = time.time,
         replanner: Optional[PlacementReplanner] = None,
+        fleet_view=None,
     ):
         self.flow_ops = flow_ops
         self.interval_s = interval_s
         self.now = now_fn
         self.replanner = replanner
+        # fleet telemetry plane: refresh the cross-replica rollup each
+        # tick so /fleet/* routes and the Prometheus rollup serve from
+        # a warm aggregate instead of paying the objstore list on read
+        self.fleet_view = fleet_view
         # flow name -> batch index -> last run epoch (oneTime: ran at all)
         self._last_run: Dict[str, Dict[int, float]] = {}
         self._stop = threading.Event()
@@ -145,6 +150,11 @@ class TimedScheduler:
                 self.replanner.on_job_event()
             except Exception:  # noqa: BLE001 — scheduler must survive
                 logger.exception("scheduled placement re-plan failed")
+        if self.fleet_view is not None:
+            try:
+                self.fleet_view.refresh()
+            except Exception:  # noqa: BLE001 — scheduler must survive
+                logger.exception("fleet telemetry refresh failed")
         return triggered
 
     # -- background loop --------------------------------------------------

@@ -70,6 +70,7 @@ COMPOSITION = {
         {"name": "query", "displayName": "Query", "path": "#/query"},
         {"name": "metrics", "displayName": "Metrics", "path": "#/metrics"},
         {"name": "jobs", "displayName": "Jobs", "path": "#/jobs"},
+        {"name": "fleet", "displayName": "Fleet", "path": "#/fleet"},
     ]
 }
 
@@ -87,6 +88,7 @@ class WebsiteServer:
         port: int = 0,
         static_dir: Optional[str] = None,
         alerts=None,
+        fleet=None,
     ):
         if api is None and gateway_url is None:
             raise ValueError("need an in-process api or a gateway_url")
@@ -95,6 +97,9 @@ class WebsiteServer:
         self.gateway_token = gateway_token
         self.store = store if store is not None else METRIC_STORE
         self.static_dir = static_dir or STATIC_DIR
+        # obs.fleetview.FleetView: when wired, /metrics appends the
+        # fleet rollup (datax_fleet_*) to the per-process exposition
+        self.fleet = fleet
         # obs.alerts.AlertEngine instances (one per flow) whose firing
         # sets the SPA annotates; register_alerts() adds more at runtime
         self.alert_engines = list(alerts or [])
@@ -151,6 +156,15 @@ class WebsiteServer:
                     # share the process HISTOGRAMS registry) + the latest
                     # point of every MetricStore key as a gauge
                     body = render_prometheus(HISTOGRAMS, ws.store).encode()
+                    if ws.fleet is not None:
+                        from ..obs.fleetview import render_fleet_prometheus
+
+                        try:
+                            body += render_fleet_prometheus(
+                                ws.fleet
+                            ).encode()
+                        except Exception:  # noqa: BLE001 — scrape survives
+                            logger.exception("fleet exposition failed")
                     self._send(
                         200, body,
                         "text/plain; version=0.0.4; charset=utf-8",

@@ -67,7 +67,8 @@ async function render() {
     .find((p) => hash.startsWith(p));
   $("#nav").replaceChildren(
     ...[["#/flows", "Flows"], ["#/query", "Query"],
-        ["#/metrics", "Metrics"], ["#/jobs", "Jobs"]].map(([href, label]) =>
+        ["#/metrics", "Metrics"], ["#/jobs", "Jobs"],
+        ["#/fleet", "Fleet"]].map(([href, label]) =>
       h("a", { href, class: hash.startsWith(href) ? "active" : "" }, label))
   );
   try {
@@ -1092,5 +1093,115 @@ route("#/jobs", async (view) => {
       },
     }, "Sync states")));
 });
+
+/* ---------------- fleet (cross-replica rollup) ---------------- */
+route("#/fleet", async (view, hash) => {
+  const flow = hash.split("/")[2];
+  if (flow) return fleetFlowView(view, decodeURIComponent(flow));
+  view.append(h("h1", {}, "Fleet"));
+  let summary;
+  try {
+    summary = await api("GET", "/api/flow/fleet/metrics");
+  } catch (e) {
+    view.append(h("div", { class: "card" },
+      "Fleet view unavailable — the control plane needs an object " +
+      `store (objectstore=) to aggregate telemetry frames. (${e.message})`));
+    return;
+  }
+  const flows = summary.flows || {};
+  const names = Object.keys(flows).sort();
+  if (!names.length) {
+    view.append(h("div", { class: "card" },
+      "No telemetry frames yet. Replica hosts publish one frame per " +
+      "window once a flow with fleet publishing runs."));
+  } else {
+    view.append(h("table", { class: "grid" },
+      h("thead", {}, h("tr", {},
+        h("th", {}, "Flow"), h("th", {}, "Replicas"), h("th", {}, "Live"),
+        h("th", {}, "Stale"), h("th", {}, "Completed"),
+        h("th", {}, "Alerts"), h("th", {}, "Audit"))),
+      h("tbody", {}, names.map((n) => {
+        const f = flows[n];
+        const statuses = Object.values(f.replicas || {}).map((r) => r.status);
+        const count = (s) => statuses.filter((x) => x === s).length;
+        const counts = (f.audit || {}).counts || {};
+        const bad = Object.values(counts).some((c) => c > 0);
+        return h("tr", {},
+          h("td", {}, h("a", { href: `#/fleet/${encodeURIComponent(n)}` }, n)),
+          h("td", {}, String(statuses.length)),
+          h("td", {}, String(count("live"))),
+          h("td", {}, String(count("stale"))),
+          h("td", {}, String(count("completed"))),
+          h("td", {}, String((f.alerts || []).length || 0)),
+          h("td", {}, h("span", { class: bad ? "status failed" : "status running" },
+            bad ? Object.entries(counts).filter(([, c]) => c > 0)
+              .map(([code, c]) => `${code}×${c}`).join(" ") : "conserved")));
+      }))));
+  }
+  view.append(h("div", { class: "row mono" },
+    `frame decode errors: ${summary.decodeErrors ?? 0}`,
+    ` · last merge: ${summary.mergeMs ?? 0} ms`));
+});
+
+async function fleetFlowView(view, flow) {
+  view.append(h("h1", {}, `Fleet: ${flow}`));
+  const f = await api("GET", `/api/flow/fleet/flows/${encodeURIComponent(flow)}`);
+  const reps = f.replicas || {};
+  view.append(h("h2", {}, "Replicas"));
+  view.append(h("table", { class: "grid" },
+    h("thead", {}, h("tr", {},
+      h("th", {}, "Replica"), h("th", {}, "Status"), h("th", {}, "Frames"),
+      h("th", {}, "Batches"), h("th", {}, "Windows"), h("th", {}, "Last seen"))),
+    h("tbody", {}, Object.keys(reps).sort().map((name) => {
+      const r = reps[name];
+      const cls = { live: "running", completed: "idle", stale: "failed" }[r.status] || "idle";
+      return h("tr", {},
+        h("td", { class: "mono" }, name),
+        h("td", {}, h("span", { class: `status ${cls}` }, r.status)),
+        h("td", {}, String(r.frames ?? 0)),
+        h("td", {}, String(r.batches ?? 0)),
+        h("td", { class: "mono" }, (r.windows || []).join("–")),
+        h("td", {}, r.lastSeenMs ? new Date(r.lastSeenMs).toLocaleTimeString() : "–"));
+    }))));
+  const hists = f.histograms || {};
+  if (Object.keys(hists).length) {
+    view.append(h("h2", {}, "Merged stage latency"));
+    view.append(h("table", { class: "grid" },
+      h("thead", {}, h("tr", {},
+        h("th", {}, "Stage"), h("th", {}, "Count"),
+        h("th", {}, "p50"), h("th", {}, "p95"), h("th", {}, "p99"))),
+      h("tbody", {}, Object.keys(hists).sort().map((s) => h("tr", {},
+        h("td", { class: "mono" }, s),
+        h("td", {}, String(hists[s].count)),
+        h("td", {}, `${hists[s].p50} ms`),
+        h("td", {}, `${hists[s].p95} ms`),
+        h("td", {}, `${hists[s].p99} ms`))))));
+  }
+  const lineage = f.lineage || [];
+  if (lineage.length) {
+    view.append(h("h2", {}, "Lineage"));
+    view.append(h("div", { class: "card mono" }, lineage.map((l, i) =>
+      h("div", {}, `${i ? "└→ " : ""}${l.replica}` +
+        (l.status ? ` [${l.status}]` : l.state ? ` [${l.state}]` : "")))));
+  }
+  const audit = f.audit || {};
+  view.append(h("h2", {}, "Delivery conservation"));
+  view.append(h("div", { class: "card" },
+    h("div", { class: "mono" }, `ingested: ${JSON.stringify(audit.ingested || {})}`),
+    h("div", { class: "mono" }, `emitted: ${JSON.stringify(audit.emitted || {})}`),
+    h("div", {}, audit.conserved
+      ? h("span", { class: "status running" }, "conserved")
+      : h("span", { class: "status failed" }, "NOT conserved")),
+    (audit.events || []).map((e) => h("div", { class: "alert-row mono" },
+      `${e.code}: ${e.name || ""} ${e.description || ""}`))));
+  const firing = f.alerts || [];
+  if (firing.length) {
+    view.append(h("h2", {}, "Fleet alerts"));
+    view.append(h("div", { class: "card alert-firing" },
+      firing.map((a) => h("div", { class: "alert-row" },
+        h("span", { class: "mono" }, `${a.severity || "warn"}: ${a.name}`),
+        ` — ${a.description || a.metric || ""}`))));
+  }
+}
 
 render();

@@ -1,0 +1,466 @@
+"""Fleet telemetry plane: exact cross-replica histogram merge, frame
+publish/rollup golden equality, fail-open frame decoding, and the
+DX54x delivery-conservation audit (obs/publisher.py + obs/fleetview.py).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from data_accelerator_tpu.obs.fleetview import (
+    FleetView,
+    render_fleet_prometheus,
+)
+from data_accelerator_tpu.obs.histogram import (
+    HistogramRegistry,
+    LatencyHistogram,
+)
+from data_accelerator_tpu.obs.publisher import (
+    TelemetryFramePublisher,
+    is_counter_metric,
+)
+
+
+class DictStore:
+    """In-memory stand-in for ObjectStoreClient (put/get/list)."""
+
+    _fleet_prefix = ""
+
+    def __init__(self):
+        self.data = {}
+
+    def put(self, key, content):
+        self.data[key] = content
+
+    def get(self, key):
+        return self.data.get(key)
+
+    def list(self, prefix=""):
+        return [k for k in self.data if k.startswith(prefix)]
+
+
+def _observed(seed, n, scale):
+    rng = np.random.default_rng(seed)
+    return (rng.gamma(2.0, scale, size=n) + 0.05).tolist()
+
+
+# ---------------------------------------------------------------------------
+# LatencyHistogram.merge exactness
+# ---------------------------------------------------------------------------
+def test_merge_percentiles_exact_over_union():
+    """Merged percentiles must equal percentiles computed over the
+    union of the replicas' raw observations — merge is exact, not an
+    approximation from bucket midpoints."""
+    samples = [_observed(s, 40, sc) for s, sc in ((1, 3.0), (2, 40.0))]
+    hists = []
+    for obs in samples:
+        h = LatencyHistogram()
+        for v in obs:
+            h.observe(v)
+        hists.append(h)
+    merged = hists[0].merge(hists[1])
+    union = np.concatenate(samples)
+    for q in (50, 90, 95, 99):
+        assert merged.percentile(q) == pytest.approx(
+            float(np.percentile(union, q)), rel=1e-9
+        )
+    assert merged.count == len(union)
+    assert merged.sum_ms == pytest.approx(float(union.sum()))
+
+
+def test_merge_associative_and_commutative_over_three_replicas():
+    samples = [_observed(s, 30, sc)
+               for s, sc in ((3, 2.0), (4, 15.0), (5, 80.0))]
+    a, b, c = [LatencyHistogram() for _ in range(3)]
+    for h, obs in zip((a, b, c), samples):
+        for v in obs:
+            h.observe(v)
+    left = a.merge(b).merge(c)
+    right = a.merge(b.merge(c))
+    swapped = c.merge(a).merge(b)
+    union = np.concatenate(samples)
+    for q in (50, 95, 99):
+        want = float(np.percentile(union, q))
+        assert left.percentile(q) == pytest.approx(want, rel=1e-9)
+        assert right.percentile(q) == pytest.approx(want, rel=1e-9)
+        assert swapped.percentile(q) == pytest.approx(want, rel=1e-9)
+    assert left.count == right.count == swapped.count == len(union)
+    assert left.to_state()["counts"] == right.to_state()["counts"]
+    assert left.to_state()["counts"] == swapped.to_state()["counts"]
+
+
+def test_merge_rejects_bucket_mismatch():
+    h1 = LatencyHistogram(buckets_ms=(1.0, 2.0))
+    h2 = LatencyHistogram(buckets_ms=(1.0, 2.0, 4.0))
+    with pytest.raises(ValueError):
+        h1.merge(h2)
+
+
+def test_merge_does_not_mutate_inputs():
+    h1, h2 = LatencyHistogram(), LatencyHistogram()
+    h1.observe(1.0)
+    h2.observe(100.0)
+    before = (h1.count, h2.count)
+    h1.merge(h2)
+    assert (h1.count, h2.count) == before
+
+
+def test_histogram_state_roundtrip_exact():
+    h = LatencyHistogram()
+    for v in _observed(6, 25, 10.0):
+        h.observe(v)
+    back = LatencyHistogram.from_state(h.to_state())
+    for q in (50, 95, 99):
+        assert back.percentile(q) == h.percentile(q)
+    assert back.count == h.count
+    assert back.to_state()["counts"] == h.to_state()["counts"]
+
+
+def test_from_state_rejects_malformed_counts():
+    h = LatencyHistogram()
+    h.observe(1.0)
+    state = h.to_state()
+    state["counts"] = state["counts"][:-2]
+    with pytest.raises(ValueError):
+        LatencyHistogram.from_state(state)
+
+
+# ---------------------------------------------------------------------------
+# publisher -> frames -> FleetView golden rollup
+# ---------------------------------------------------------------------------
+def _publisher(store, replica, index, count=2, flow="GoldFlow"):
+    return TelemetryFramePublisher(
+        url="objstore://unused/dxtpu",
+        flow=flow,
+        replica=replica,
+        replica_index=index,
+        replica_count=count,
+        window_s=0.0,
+        histograms=HistogramRegistry(),
+        client=store,
+    )
+
+
+def test_two_replica_rollup_golden_equal():
+    """Fleet counters == sum of the per-replica contributions; merged
+    p50/p99 == percentiles over the unioned raw observations."""
+    store = DictStore()
+    obs_by_rep = {"r1": _observed(7, 35, 5.0), "r2": _observed(8, 35, 50.0)}
+    per_rep_counters = {"r1": 3, "r2": 5}
+    for rep, index in (("r1", 1), ("r2", 2)):
+        pub = _publisher(store, rep, index)
+        for i in range(per_rep_counters[rep]):
+            pub.record_batch(
+                {
+                    "Input_default_Events_Count": 4.0,
+                    "Output_Out_Events_Count": 4.0,
+                    "Batch_ProcessedMs": 12.5,
+                },
+                consumed={("default", 0): (i * 4, i * 4 + 4)},
+                batch_time_ms=1000 + i,
+            )
+        for v in obs_by_rep[rep]:
+            pub.histograms.observe("GoldFlow", "process", v)
+        assert pub.flush(final=True)
+
+    view = FleetView(client=store)
+    assert view.refresh() > 0
+    fm = view.fleet_metrics("GoldFlow")
+    total_batches = sum(per_rep_counters.values())
+    assert fm["counters"]["Input_default_Events_Count"] == 4.0 * total_batches
+    assert fm["counters"]["Output_Out_Events_Count"] == 4.0 * total_batches
+    # golden: merged == sum of the per-replica breakdowns it retains
+    for metric in ("Input_default_Events_Count", "Output_Out_Events_Count"):
+        assert fm["counters"][metric] == sum(
+            fm["replicas"][r]["counters"][metric] for r in ("r1", "r2")
+        )
+    union = np.concatenate(list(obs_by_rep.values()))
+    merged = view.histograms.get("GoldFlow", "process")
+    for q in (50, 99):
+        assert merged.percentile(q) == pytest.approx(
+            float(np.percentile(union, q)), rel=1e-9
+        )
+    # both replicas drained cleanly -> completed, conserved, no events
+    assert all(
+        r["status"] == "completed" for r in fm["replicas"].values()
+    )
+    audit = fm["audit"]
+    assert audit["conserved"]
+    assert audit["counts"] == {"DX540": 0, "DX541": 0, "DX542": 0}
+    # offset ranges survived the trip (min/max merged per source:part)
+    assert fm["replicas"]["r1"]["offsets"]["default:0"] == [0, 12]
+
+
+def test_counter_gauge_classification():
+    assert is_counter_metric("Input_default_Events_Count")
+    assert is_counter_metric("Kafka_Fetch_Bytes")
+    assert not is_counter_metric("Batch_ProcessedMs")
+    assert not is_counter_metric("Pipeline_Depth")
+
+
+# ---------------------------------------------------------------------------
+# fail-open: corrupt frames skipped and counted, publisher outages
+# ---------------------------------------------------------------------------
+class FlakyStore(DictStore):
+    """A store whose get() serves a planned sequence of corruptions."""
+
+    def __init__(self):
+        super().__init__()
+        self.vanished = set()
+
+    def get(self, key):
+        if key in self.vanished:
+            return None
+        return super().get(key)
+
+
+def _good_frame(window=0, replica="r1", flow="FailOpen", **extra):
+    frame = {
+        "version": 1,
+        "flow": flow,
+        "replica": replica,
+        "window": window,
+        "counters": {"Input_default_Events_Count": 2.0},
+        "batches": 1,
+        "publishedAtMs": 1000 + window,
+    }
+    frame.update(extra)
+    return frame
+
+
+def test_corrupt_frames_skipped_and_counted_never_crash():
+    store = FlakyStore()
+    store.put("fleet/FailOpen/r1/00000000.json",
+              json.dumps(_good_frame(0)).encode())
+    # truncated JSON
+    store.put("fleet/FailOpen/r1/00000001.json",
+              json.dumps(_good_frame(1)).encode()[:25])
+    # not JSON at all
+    store.put("fleet/FailOpen/r1/00000002.json", b"\x00\xff garbage")
+    # JSON but not an object
+    store.put("fleet/FailOpen/r1/00000003.json", b"[1,2,3]")
+    # missing required fields
+    store.put("fleet/FailOpen/r1/00000004.json",
+              json.dumps({"flow": "FailOpen", "replica": "r1"}).encode())
+    # version from the future
+    store.put("fleet/FailOpen/r1/00000005.json",
+              json.dumps(_good_frame(5, version=99)).encode())
+    # vanishes between list and get
+    store.put("fleet/FailOpen/r1/00000006.json",
+              json.dumps(_good_frame(6)).encode())
+    store.vanished.add("fleet/FailOpen/r1/00000006.json")
+    # and one more good frame after all the carnage
+    store.put("fleet/FailOpen/r1/00000007.json",
+              json.dumps(_good_frame(7)).encode())
+
+    view = FleetView(client=store)
+    assert view.refresh() == 2          # only the two good frames
+    assert view.decode_errors == 6
+    fm = view.fleet_metrics("FailOpen")
+    assert fm["counters"]["Input_default_Events_Count"] == 4.0
+    # already-seen keys are not re-counted on the next refresh
+    assert view.refresh() == 0
+    assert view.decode_errors == 6
+
+
+def test_unlistable_store_yields_zero_not_crash():
+    class DownStore(DictStore):
+        def list(self, prefix=""):
+            raise OSError("store unreachable")
+
+    view = FleetView(client=DownStore())
+    assert view.refresh() == 0
+
+
+def test_publisher_fail_open_retains_window_across_outage():
+    class OutageStore(DictStore):
+        def __init__(self):
+            super().__init__()
+            self.down = True
+
+        def put(self, key, content):
+            if self.down:
+                raise OSError("store down")
+            super().put(key, content)
+
+    store = OutageStore()
+    pub = _publisher(store, "r1", 1, count=1, flow="Outage")
+    # window_s=0 -> record_batch itself attempts the publish
+    pub.record_batch({"Input_default_Events_Count": 3.0}, batch_time_ms=1)
+    assert pub.publish_errors == 1
+    assert not store.data
+    store.down = False
+    pub.record_batch({"Input_default_Events_Count": 5.0}, batch_time_ms=2)
+    (body,) = store.data.values()
+    frame = json.loads(body)
+    # the recovered frame carries the missed window's delta too
+    assert frame["counters"]["Input_default_Events_Count"] == 8.0
+    assert pub.frames_published == 1
+
+
+def test_kill_suppresses_final_frame():
+    store = DictStore()
+    pub = _publisher(store, "r1", 1, count=1, flow="Killed")
+    pub.record_batch({"Input_default_Events_Count": 1.0}, batch_time_ms=1)
+    assert pub.flush()
+    pub.kill()
+    assert not pub.flush(final=True)
+    frames = [json.loads(v) for v in store.data.values()]
+    assert len(frames) == 1 and not frames[0]["final"]
+
+
+# ---------------------------------------------------------------------------
+# DX54x delivery-conservation audit
+# ---------------------------------------------------------------------------
+def test_dropped_batch_fires_dx540_exactly_once():
+    view = FleetView(client=DictStore())
+    view.ingest_frame(_good_frame(
+        0, flow="Lossy",
+        delivery={"ingested": {"default": 10.0},
+                  "emitted": {"Out": 6.0}},
+        final=True,
+    ))
+    for _ in range(3):  # repeated audits must not re-fire
+        audit = view.audit("Lossy")
+        assert audit["counts"]["DX540"] == 1
+        assert audit["counts"]["DX541"] == 0
+        assert not audit["conserved"]
+        (ev,) = [e for e in audit["events"] if e["code"] == "DX540"]
+        assert ev["ingested"] == 10.0 and ev["emitted"] == 6.0
+
+
+def test_duplication_fires_dx541():
+    view = FleetView(client=DictStore())
+    view.ingest_frame(_good_frame(
+        0, flow="Dup",
+        delivery={"ingested": {"default": 4.0},
+                  "emitted": {"Out": 7.0}},
+        final=True,
+    ))
+    audit = view.audit("Dup")
+    assert audit["counts"] == {"DX540": 0, "DX541": 1, "DX542": 0}
+
+
+def test_audited_output_defaults_to_busiest_and_is_overridable():
+    view = FleetView(client=DictStore())
+    view.ingest_frame(_good_frame(
+        0, flow="TwoOut",
+        delivery={"ingested": {"default": 10.0},
+                  "emitted": {"Out": 10.0, "Win": 3.0}},
+        final=True,
+    ))
+    # default: the passthrough (max-emitted) output conserves
+    assert view.audit("TwoOut")["conserved"]
+    # explicitly auditing the windowed aggregate under-emits -> DX540
+    forced = view.audit("TwoOut", output="Win")
+    assert forced["counts"]["DX540"] == 1
+
+
+def test_stale_replica_fires_dx542_and_final_marker_completes():
+    now = {"t": 100.0}
+    view = FleetView(client=DictStore(), now_fn=lambda: now["t"])
+    view.ingest_frame(_good_frame(
+        0, replica="drained", flow="Stale",
+        windowSeconds=1.0, publishedAtMs=50_000, final=True,
+        delivery={"ingested": {"default": 2.0}, "emitted": {"Out": 2.0}},
+    ))
+    view.ingest_frame(_good_frame(
+        0, replica="vanished", flow="Stale",
+        windowSeconds=1.0, publishedAtMs=50_000,
+        delivery={"ingested": {"default": 2.0}, "emitted": {"Out": 2.0}},
+    ))
+    # within the 2-window horizon: live, no DX542
+    now["t"] = 51.0
+    fm = view.fleet_metrics("Stale")
+    assert fm["replicas"]["vanished"]["status"] == "live"
+    assert fm["audit"]["counts"]["DX542"] == 0
+    # quiet past 2 windows WITHOUT a final frame: stale
+    now["t"] = 60.0
+    fm = view.fleet_metrics("Stale")
+    assert fm["replicas"]["drained"]["status"] == "completed"
+    assert fm["replicas"]["vanished"]["status"] == "stale"
+    assert fm["staleReplicas"] == ["vanished"]
+    audit = fm["audit"]
+    assert audit["counts"]["DX542"] == 1
+    (ev,) = [e for e in audit["events"] if e["code"] == "DX542"]
+    assert ev["replica"] == "vanished"
+    # totals still balance: staleness is not a conservation violation
+    assert audit["conserved"]
+
+
+# ---------------------------------------------------------------------------
+# lineage + surfaces
+# ---------------------------------------------------------------------------
+def test_lineage_prefers_registry_records_falls_back_to_frames():
+    records = [{"replica": "base", "replicaIndex": 1}]
+    view = FleetView(client=DictStore(), lineage_fn=lambda flow: records)
+    view.ingest_frame(_good_frame(0, replica="g0-r1", flow="Lin",
+                                  publishedAtMs=1000))
+    view.ingest_frame(_good_frame(0, replica="g1-r1", flow="Lin",
+                                  publishedAtMs=2000))
+    assert view.lineage("Lin") == records
+    # registry outage -> frame-derived lineage in first-seen order
+    def broken(flow):
+        raise OSError("registry down")
+
+    view.lineage_fn = broken
+    lin = view.lineage("Lin")
+    assert [seg["replica"] for seg in lin] == ["g0-r1", "g1-r1"]
+
+
+def test_fleet_prometheus_rollup_renders():
+    view = FleetView(client=DictStore())
+    view.ingest_frame(_good_frame(
+        0, flow="Promo", final=True,
+        delivery={"ingested": {"default": 2.0}, "emitted": {"Out": 2.0}},
+    ))
+    text = render_fleet_prometheus(view)
+    assert 'datax_fleet_metric_total{flow="Promo"' in text
+    assert "datax_fleet_replicas{" in text
+    assert "datax_fleet_frame_decode_errors_total 0" in text
+
+
+def test_restapi_fleet_routes(tmp_path):
+    from data_accelerator_tpu.serve.restapi import DataXApi
+
+    class Runtime:
+        def resolve(self, name):
+            return str(tmp_path / name)
+
+    class Ops:  # the fleet routes only need the compile-cache root
+        runtime = Runtime()
+
+    view = FleetView(client=DictStore())
+    view.ingest_frame(_good_frame(0, flow="Api", final=True))
+    api = DataXApi(Ops(), fleet=view)
+    status, payload = api.dispatch("GET", "fleet/metrics")
+    assert status == 200
+    assert "Api" in payload["result"]["flows"]
+    status, payload = api.dispatch("GET", "fleet/flows/Api")
+    assert status == 200
+    assert payload["result"]["flow"] == "Api"
+    status, _ = api.dispatch("GET", "fleet/flows/NoSuchFlow")
+    assert status == 404
+    api_off = DataXApi(Ops())
+    status, _ = api_off.dispatch("GET", "fleet/metrics")
+    assert status == 503
+
+
+def test_obs_trace_stitch_groups_by_replica_tag():
+    from data_accelerator_tpu.obs.__main__ import stitch_lineage
+
+    spans = [
+        {"trace": "t1", "span": "a", "startTs": 1.0,
+         "properties": {"replica": "g0-r1", "batchTime": 1}},
+        {"trace": "t1", "span": "b", "parent": "a", "startTs": 1.1,
+         "properties": {}},
+        {"trace": "t2", "span": "c", "startTs": 5.0,
+         "properties": {"replica": "g1-r1", "batchTime": 2}},
+        {"trace": "t3", "span": "d", "startTs": 3.0,
+         "properties": {"replica": "g0-r1", "batchTime": 3}},
+    ]
+    segments = stitch_lineage(spans, ["t1", "t2", "t3"])
+    assert [rep for rep, _ in segments] == ["g0-r1", "g1-r1"]
+    assert segments[0][1] == ["t1", "t3"]  # within-segment start order
+    assert segments[1][1] == ["t2"]

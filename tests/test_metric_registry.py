@@ -270,6 +270,35 @@ def test_lq_serving_metrics_are_registered():
     assert MetricName.stage_metric("lq-exec") == "Latency-LQExec"
 
 
+def test_fleet_telemetry_metrics_are_registered():
+    """The fleet telemetry plane's series (obs/publisher.py self-metrics
+    under the publishing host's app, obs/fleetview.py aggregator stats)
+    and the DX54x delivery-conservation audit counters resolve through
+    the registry; emission-side coverage is tests/test_fleetview.py and
+    the rescale chaos drill's assert_fleet_view step."""
+    for m in (
+        "Fleet_Frames_Count",
+        "Fleet_Frame_Bytes",
+        "Fleet_FramePublish_Ms",
+        "Fleet_FramePublishError_Count",
+        "Fleet_FrameDecodeError_Count",
+        "Fleet_MergeLatency_Ms",
+        "Fleet_Replicas_Count",
+        "Fleet_StaleReplicas_Count",
+        "Conformance_Delivery_Loss_Count",
+        "Conformance_Delivery_Duplicate_Count",
+        "Conformance_Delivery_StaleReplica_Count",
+    ):
+        assert MetricName.is_runtime_metric(m), m
+    assert not MetricName.is_runtime_metric("Fleet_Bogus")
+    assert not MetricName.is_runtime_metric("Fleet_Frame_Bogus")
+    assert not MetricName.is_runtime_metric("Conformance_Delivery_Bogus")
+    # the named constants stay in lockstep with the pattern table
+    assert MetricName.FLEET_FRAMES == "Fleet_Frames_Count"
+    assert MetricName.FLEET_FRAME_DECODE_ERROR == "Fleet_FrameDecodeError_Count"
+    assert MetricName.DELIVERY_LOSS == "Conformance_Delivery_Loss_Count"
+
+
 def test_default_alert_rules_validate_and_resolve_for_shipped_flows():
     """CI satellite: the default-generated alert rules are
     schema-valid, and every threshold rule's series name resolves
