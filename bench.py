@@ -723,6 +723,61 @@ def bench_sanitizer(capacity=8192, warmup=2, iters=8):
     }
 
 
+def bench_protocheck(iters=200):
+    """Protocol-gate cost block: the static tier's analysis latency
+    over the engine packages (cold parse+walk vs the mtime-keyed
+    cache hit the CLI/REST/CI path normally takes) and the runtime
+    monitor's per-batch cost (the batch tail's record calls + the
+    seal-time linearization check) armed vs off. The cold number is
+    gated in ``regression``: the protocol gate runs in every CI
+    validate call, so its cost is a committed number.
+    ``violations`` doubles as a live engine check — any nonzero means
+    the bench's well-ordered tail itself broke the spec."""
+    from data_accelerator_tpu.analysis.protocheck import (
+        _ENGINE_CACHE,
+        analyze_flow_protocol,
+    )
+    from data_accelerator_tpu.runtime.protocolmonitor import ProtocolMonitor
+
+    _ENGINE_CACHE.clear()
+    t0 = time.perf_counter()
+    report = analyze_flow_protocol({"name": "Bench"})
+    cold_ms = (time.perf_counter() - t0) * 1000.0
+    t0 = time.perf_counter()
+    analyze_flow_protocol({"name": "Bench"})
+    cached_ms = (time.perf_counter() - t0) * 1000.0
+
+    # the monitor's whole per-batch footprint: the tail's event
+    # records + one seal. Armed phase first, like the sanitizer block:
+    # process warmup then favors the off run, so the published
+    # overhead is the conservative (overstated) side of the truth.
+    def run(pm):
+        t0 = time.perf_counter()
+        for i in range(iters):
+            if pm is not None:
+                pm.record("SINK_EMIT", detail="dispatcher.dispatch")
+                pm.record("POINTER_FLIP", detail="processor.commit")
+                pm.record("FIFO_ACK", source="default")
+                pm.record("DURABLE_WRITE", detail="window_checkpointer.save")
+                pm.record("STATE_PUSH", detail="push_window_partitions")
+                pm.record("OFFSET_COMMIT", detail="checkpoint_batch")
+                pm.seal_batch(float(i))
+        return (time.perf_counter() - t0) / iters * 1e6
+
+    mon = ProtocolMonitor()
+    on_us = run(mon)
+    off_us = run(None)
+    return {
+        "cold_ms": round(cold_ms, 2),
+        "cached_ms": round(cached_ms, 3),
+        "analyzed_files": len(report.modules),
+        "effect_events": report.effect_events,
+        "monitor_off_us_per_batch": round(off_us, 3),
+        "monitor_on_us_per_batch": round(on_us, 3),
+        "violations": mon.violations,
+    }
+
+
 def bench_pilot_overhead(iters=2000):
     """Autopilot hot-path overhead block: the pilot rides the dispatch
     loop (``tick`` per iteration, ``admit_events`` + ``observe_poll``
@@ -1078,6 +1133,11 @@ def regression_gate(current: dict, tolerance: float = 0.10):
     # either means the observability plane itself got expensive
     d_fleet_pub = nested_delta("fleet_rollup", "publish_ms")
     d_fleet_merge = nested_delta("fleet_rollup", "merge_ms")
+    # protocol-gate cost: the static tier's cold analysis latency
+    # rides every CI validate call — a >band worsening fails. (The
+    # cached path is sub-ms and too jittery to gate; it is published
+    # in the block instead.)
+    d_proto_cold = nested_delta("protocheck", "cold_ms")
     # cold-start gate: warm time-to-first-batch is the restart/
     # preemption-recovery promise — a >band worsening (or warm no
     # longer beating cold at all) fails like an events/s drop
@@ -1108,6 +1168,13 @@ def regression_gate(current: dict, tolerance: float = 0.10):
             bool(current.get("fleet_rollup"))
             and not current["fleet_rollup"].get("conserved", True)
         )
+        or (d_proto_cold is not None and d_proto_cold > tolerance)
+        # acceptance bit: the bench's own well-ordered tail must seal
+        # violation-free through the armed monitor
+        or (
+            bool(current.get("protocheck"))
+            and current["protocheck"].get("violations", 0) != 0
+        )
     )
     return {
         "baseline": os.path.basename(latest),
@@ -1118,6 +1185,7 @@ def regression_gate(current: dict, tolerance: float = 0.10):
         "warm_first_batch_delta": d_warm_first,
         "lq_kernel_qps_delta": d_lq_qps,
         "lq_p99_exec_delta": d_lq_p99,
+        "protocheck_cold_delta": d_proto_cold,
         "fleet_publish_delta": d_fleet_pub,
         "fleet_merge_delta": d_fleet_merge,
         "tolerance": tolerance,
@@ -1301,6 +1369,11 @@ def main():
         # scan), published so arming it in production is an informed
         # choice; no regression gate
         "sanitizer": bench_sanitizer(),
+        # the DX9xx protocol gate: static analysis latency (cold vs
+        # the mtime cache hit) and the DX906 monitor's per-batch cost;
+        # the cold number is regression-gated (it rides every CI
+        # validate call)
+        "protocheck": bench_protocheck(),
         "pilot": bench_pilot_overhead(),
         # the "millions of users" axis: interactive kernel QPS + p99
         # exec latency under multi-tenant open-loop load, published

@@ -4,7 +4,7 @@ Design-time counterpart to the runtime compiler — reuses the production
 codegen + parsers so a bad flow config fails in milliseconds with a
 ``DXnnn``-coded diagnostic instead of minutes into a deployed job.
 
-Seven tiers:
+Eight tiers:
 
 - the semantic tier (``analyze_flow``): reference resolution, type
   propagation, legality, dead flow, device-compilation risk;
@@ -36,12 +36,19 @@ Seven tiers:
   escaped-donated-view / zero-copy / lockset / re-donation /
   blocking-sync lints (``racecheck.py``); its dynamic counterpart is
   ``runtime/sanitizer.py`` (runtime DX805, conf
-  ``process.debug.buffersanitizer``).
+  ``process.debug.buffersanitizer``);
+- the protocol tier (``analyze_flow_protocol``): exactly-once
+  delivery-protocol analysis of the engine modules plus the rescale
+  handoff (``serve/jobs.py``) — typed effect traces per entry point
+  checked against the declared ordering spec (``protospec.py``), the
+  DX90x durability/ordering/requeue/handoff lints (``protocheck.py``);
+  its dynamic counterpart is ``runtime/protocolmonitor.py`` (runtime
+  DX906, conf ``process.debug.protocolmonitor``).
 
 CLI: ``python -m data_accelerator_tpu.analysis flow.json [--json]
 [--device [--chips N]] [--udfs] [--fleet [--fleet-spec=spec.json]]
 [--compile [--manifest=m.json] [--manifest-out=m.json]]
-[--mesh [--chips N]] [--race] [--all]``
+[--mesh [--chips N]] [--race] [--protocol] [--all]``
 (non-zero exit on error-severity diagnostics, optional tiers included
 when requested; ``--all`` runs every tier in one invocation).
 """
@@ -98,6 +105,21 @@ from .meshcheck import (
     analyze_flow_mesh,
     analyze_processor_mesh,
 )
+from .protocheck import (
+    PROTO_EXTRA_MODULES,
+    ProtoCheckReport,
+    ProtoModuleSummary,
+    analyze_flow_protocol,
+    analyze_proto_modules,
+    proto_module_paths,
+)
+from .protospec import (
+    EVENT_KINDS,
+    RULES,
+    RULES_BY_CODE,
+    ProtocolRule,
+    check_sequence,
+)
 from .racecheck import (
     ENGINE_PACKAGES,
     RaceCheckReport,
@@ -127,6 +149,13 @@ __all__ = [
     "DevicePlanReport",
     "Diagnostic",
     "ENGINE_PACKAGES",
+    "EVENT_KINDS",
+    "PROTO_EXTRA_MODULES",
+    "ProtoCheckReport",
+    "ProtoModuleSummary",
+    "ProtocolRule",
+    "RULES",
+    "RULES_BY_CODE",
     "RaceCheckReport",
     "RaceModuleSummary",
     "MeshPlanReport",
@@ -153,18 +182,22 @@ __all__ = [
     "analyze_flow_compile",
     "analyze_flow_device",
     "analyze_flow_mesh",
+    "analyze_flow_protocol",
     "analyze_flow_race",
     "analyze_flow_udfs",
     "analyze_modules",
     "analyze_processor",
     "analyze_processor_compile",
     "analyze_processor_mesh",
+    "analyze_proto_modules",
     "analyze_script",
     "parse_chip_count",
     "check_udf_object",
     "combined_report_dict",
+    "check_sequence",
     "engine_module_paths",
     "flow_footprint",
+    "proto_module_paths",
     "load_fleet_spec",
     "pack_fleet",
     "schema_to_types",

@@ -4,7 +4,7 @@
         [--json] [--device] [--chips=N] [--udfs]
         [--fleet] [--fleet-spec=spec.json]
         [--compile] [--manifest=m.json] [--manifest-out=m.json]
-        [--mesh] [--race] [--all]
+        [--mesh] [--race] [--protocol] [--all]
 
 Each argument is a flow config file: either a designer gui JSON or a
 full flow document (``{"gui": {...}}``). Prints one line per diagnostic
@@ -70,10 +70,25 @@ syncs on non-blocking threads (DX804). A clean report certifies the
 runtime for ANY flow, so the result is cached per engine-source state.
 Same exit contract — this is the standing CI race gate.
 
+``--protocol`` runs the exactly-once delivery-protocol tier
+(``analysis/protocheck.py``): like ``--race`` its subject is the
+ENGINE — every ``runtime/``, ``lq/`` and ``pilot/`` module plus the
+rescale handoff in ``serve/jobs.py`` — per entry point a typed effect
+trace of protocol events (sink emit, durable write, pointer flip,
+FIFO ack, offset commit, state push, requeue, drain) is extracted and
+checked against the declared ordering spec
+(``analysis/protospec.py``), emitting the DX90x lints: ack before
+durability (DX900), pointer flip before sink emit (DX901), double ack
+(DX902), uncovered requeue window (DX903), effects outside the
+requeue scope (DX904) and a successor dispatched before its handoff
+pull (DX905). Cached per engine-source state; same exit contract —
+this is the CI gate the exchange-plane and drain-protocol work builds
+behind.
+
 ``--all`` runs every tier in one invocation (semantic + device + udfs
-+ fleet + compile + mesh + race) with one merged ``--json`` report
-(single ``schemaVersion``, combined diagnostics, same 0/1/2 exit
-contract) — one CI call instead of seven flags.
++ fleet + compile + mesh + race + protocol) with one merged ``--json``
+report (single ``schemaVersion``, combined diagnostics, same 0/1/2
+exit contract) — one CI call instead of eight flags.
 
 Unknown ``--`` flags are rejected with exit 2 (a typo like ``--devcie``
 must not silently skip a tier and report a false clean pass).
@@ -193,7 +208,7 @@ def _print_fleet_plan(fleet) -> None:
 # flags the CLI understands; anything else --prefixed is a usage error
 # (a typo like --devcie must not silently skip a tier)
 KNOWN_FLAGS = {"--json", "--device", "--udfs", "--fleet", "--compile",
-               "--mesh", "--race", "--all"}
+               "--mesh", "--race", "--protocol", "--all"}
 KNOWN_VALUE_FLAGS = ("--chips=", "--fleet-spec=", "--manifest=",
                      "--manifest-out=")
 
@@ -210,6 +225,7 @@ def main(argv: List[str]) -> int:
     compile_tier = "--compile" in argv or all_tiers
     mesh_tier = "--mesh" in argv or all_tiers
     race_tier = "--race" in argv or all_tiers
+    protocol_tier = "--protocol" in argv or all_tiers
     chips: Optional[int] = None
     fleet_spec_path: Optional[str] = None
     manifest_path: Optional[str] = None
@@ -266,6 +282,7 @@ def main(argv: List[str]) -> int:
     from .deviceplan import analyze_flow_device, combined_report_dict
     from .diagnostics import REPORT_SCHEMA_VERSION
     from .meshcheck import analyze_flow_mesh
+    from .protocheck import analyze_flow_protocol
     from .racecheck import analyze_flow_race
     from .udfcheck import analyze_flow_udfs
 
@@ -314,6 +331,9 @@ def main(argv: List[str]) -> int:
         )
         mesh = analyze_flow_mesh(flow, chips=chips) if mesh_tier else None
         race = analyze_flow_race(flow) if race_tier else None
+        protocol = (
+            analyze_flow_protocol(flow) if protocol_tier else None
+        )
         any_errors |= not report.ok
         if device is not None:
             any_errors |= not device.ok
@@ -328,17 +348,19 @@ def main(argv: List[str]) -> int:
             any_errors |= not mesh.ok
         if race is not None:
             any_errors |= not race.ok
+        if protocol is not None:
+            any_errors |= not protocol.ok
         if as_json:
             if (
                 device is not None or udfs is not None
                 or comp is not None or mesh is not None
-                or race is not None
+                or race is not None or protocol is not None
             ):
                 json_out.append({
                     "file": path,
                     **combined_report_dict(
                         report, device, udfs, compile_surface=comp,
-                        mesh=mesh, race=race,
+                        mesh=mesh, race=race, protocol=protocol,
                     ),
                 })
             else:
@@ -350,6 +372,8 @@ def main(argv: List[str]) -> int:
                 list(comp.diagnostics) if comp is not None else []
             ) + (list(mesh.diagnostics) if mesh is not None else []) + (
                 list(race.diagnostics) if race is not None else []
+            ) + (
+                list(protocol.diagnostics) if protocol is not None else []
             )
             for d in diags:
                 print(f"{path}: {d.render()}")
@@ -385,6 +409,16 @@ def main(argv: List[str]) -> int:
                     f"{rd['allowedZeroCopySites']} pinned zero-copy "
                     f"site(s), {rd['ownerHandoffSites']} owner "
                     f"handoff(s)"
+                )
+            if protocol is not None:
+                pd = protocol.protocol_dict()
+                print(
+                    f"{path}: protocol gate: {pd['analyzedFiles']} "
+                    f"engine module(s) analyzed, "
+                    f"{pd['effectEvents']} effect event(s), "
+                    f"{pd['postCommitSites']} pinned post-commit "
+                    f"site(s), {pd['requeueUpstreamSites']} "
+                    f"requeue-upstream site(s)"
                 )
 
     fleet = None

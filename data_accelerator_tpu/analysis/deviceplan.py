@@ -261,18 +261,20 @@ def _ordered(diags: List[Diagnostic]) -> List[Diagnostic]:
 def combined_report_dict(
     base: AnalysisReport, device: Optional[DevicePlanReport] = None,
     udfs=None, fleet=None, compile_surface=None, mesh=None, race=None,
+    protocol=None,
 ) -> dict:
     """Merge the semantic tier with the optional device, UDF, fleet,
-    compile, mesh and race tiers into one response: a superset of
-    ``AnalysisReport.to_dict()`` plus a ``device`` cost report, a
-    ``udfs`` summary, a ``fleet`` placement plan, a ``compile``
-    surface+manifest, a ``mesh`` sharding plan and/or a ``race``
-    engine buffer-lifetime gate — what ``flow/validate`` returns with
-    ``device: true`` / ``udfs: true`` / ``fleet: true`` / ``compile:
-    true`` / ``mesh: true`` / ``race: true`` (or ``all: true``) and
-    what the CLI's tier flags (or ``--all``) ``--json`` print: one
-    ``schemaVersion``, one merged diagnostics list, one exit
-    contract."""
+    compile, mesh, race and protocol tiers into one response: a
+    superset of ``AnalysisReport.to_dict()`` plus a ``device`` cost
+    report, a ``udfs`` summary, a ``fleet`` placement plan, a
+    ``compile`` surface+manifest, a ``mesh`` sharding plan, a ``race``
+    engine buffer-lifetime gate and/or a ``protocol`` exactly-once
+    delivery gate — what ``flow/validate`` returns with ``device:
+    true`` / ``udfs: true`` / ``fleet: true`` / ``compile: true`` /
+    ``mesh: true`` / ``race: true`` / ``protocol: true`` (or ``all:
+    true``) and what the CLI's tier flags (or ``--all``) ``--json``
+    print: one ``schemaVersion``, one merged diagnostics list, one
+    exit contract."""
     from .diagnostics import REPORT_SCHEMA_VERSION
 
     diags = list(base.diagnostics)
@@ -288,6 +290,8 @@ def combined_report_dict(
         diags += list(mesh.diagnostics)
     if race is not None:
         diags += list(race.diagnostics)
+    if protocol is not None:
+        diags += list(protocol.diagnostics)
     diags = _ordered(diags)
     errors = [d for d in diags if d.is_error]
     out = {
@@ -309,6 +313,8 @@ def combined_report_dict(
         out["mesh"] = mesh.mesh_dict()
     if race is not None:
         out["race"] = race.race_dict()
+    if protocol is not None:
+        out["protocol"] = protocol.protocol_dict()
     return out
 
 

@@ -230,6 +230,24 @@ CODES: Dict[str, tuple] = {
               "gate the donation on the previous slot's _landed.is_set()/wait() (the slot-rotation contract the compile manifest's donate pattern assumes)"),
     "DX804": (SEV_ERROR, "blocking device sync on a thread the pipeline model requires non-blocking: block_until_ready/device_get/a blocking wait inside a function marked '# dx-race: non-blocking' stalls the dispatch overlap the depth-N window exists to provide",
               "move the sync to the landing thread (collect_counts is the one sanctioned sync point), use the async copy path, or drop the non-blocking marker if the function is genuinely allowed to block"),
+    # -- pass 12: exactly-once delivery protocol (analysis/protocheck.py,
+    #    the --protocol tier: typed effect-trace extraction over the
+    #    engine packages + serve/jobs.py, checked against the declared
+    #    ordering-rule table in analysis/protospec.py. DX906 is the
+    #    runtime half (runtime/protocolmonitor.py), fired into the
+    #    flight recorder, never by the static pass) -------------------
+    "DX900": (SEV_ERROR, "durability-before-ack violated: the upstream FIFO is acked before the durable pointer flip, or an os.replace runs without the tmp-file fsync before the rename and the parent-dir fsync after it",
+              "move the ack after processor.commit()/the pointer flip; fence every checkpoint rename with fsync(tmp) then os.replace then fsync(dir) (use _durable_replace)"),
+    "DX901": (SEV_ERROR, "sink-before-pointer-commit violated: the state-table pointer flips before the sinks accepted the batch, so a replay after a sink failure double-counts the committed rows",
+              "dispatch to sinks first and flip the pointer only after dispatch returns (the order StreamingHost._finish_tail and the BatchHost landing tail establish)"),
+    "DX902": (SEV_ERROR, "ack-at-most-once-per-batch violated: more than one ack call site on one batch path — a second ack releases a window the failure path still expects to requeue",
+              "keep a single ack loop per batch tail; route every early-exit through the same commit point"),
+    "DX903": (SEV_ERROR, "requeue-covers-unacked-window violated: a function that acks has no failure handler requeuing the unacked window, or a looped ack is paired with a single-source requeue",
+              "requeue every source in the except handler that guards the ack (or mark a delegating wrapper '# dx-proto: requeue-upstream <reason>' when the caller owns the handler)"),
+    "DX904": (SEV_ERROR, "effect-outside-requeue-scope: a pre-ack effect sits outside any try whose handler requeues, or a post-ack effect (offset commit / snapshot write) is not declared with a post-commit marker",
+              "wrap pre-ack effects in the requeue-guarded try; annotate designed at-least-once tails '# dx-proto: post-commit <reason>' so the inventory pins them"),
+    "DX905": (SEV_ERROR, "handoff-pull-before-first-dispatch violated: a rescale dispatches a successor job before pulling/stamping its owned-partition plan, so the replica boots without its state assignment",
+              "compute _state_partition_plan and stamp statePartitionsOwned/confOverrides on the record before client.submit"),
 }
 
 # which pass each code family belongs to (for grouping/reporting)
@@ -250,6 +268,7 @@ PASS_NAMES = {
     "DX70": "mesh sharding",
     "DX79": "mesh sharding",
     "DX80": "buffer lifetime/race",
+    "DX90": "delivery protocol",
 }
 
 # version of every ``--json`` report shape the analysis tiers emit (the
@@ -260,7 +279,9 @@ PASS_NAMES = {
 # v2: the ``mesh`` report block (the --mesh tier's sharding plan).
 # v3: the ``race`` report block (the --race tier's engine buffer-
 # lifetime/concurrency gate).
-REPORT_SCHEMA_VERSION = 3
+# v4: the ``protocol`` report block (the --protocol tier's exactly-
+# once delivery-protocol gate).
+REPORT_SCHEMA_VERSION = 4
 
 
 def make(code: str, table: str, message: str, span: Optional[Span] = None,

@@ -145,6 +145,7 @@ class _Markers:
 
 def _collect_markers(
     lines: List[str], tree: Optional[ast.AST] = None,
+    marker_re: "re.Pattern" = _MARKER_RE,
 ) -> _Markers:
     m = _Markers()
     # statement spans let a marker above a multi-line statement cover
@@ -158,7 +159,7 @@ def _collect_markers(
                     node.lineno, getattr(node, "end_lineno", node.lineno)
                 )
     for i, text in enumerate(lines, start=1):
-        match = _MARKER_RE.search(text)
+        match = marker_re.search(text)
         if not match:
             continue
         kind, rest = match.group(1), match.group(2).strip()

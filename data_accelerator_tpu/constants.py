@@ -157,6 +157,13 @@ class MetricName:
         # half of the DX8xx buffer-lifetime analyzer
         r"Sanitizer_GuardedViews_Count",
         r"Sanitizer_PoisonHit_Count",
+        # protocol monitor (runtime/protocolmonitor.py, armed via
+        # process.debug.protocolmonitor): delivery-protocol events
+        # recorded per batch tail, and sealed-batch ordering violations
+        # — runtime DX906, the dynamic half of the DX9xx exactly-once
+        # protocol analyzer
+        r"Protocol_Events_Count",
+        r"Protocol_Violation_Count",
         # device-resident result path (runtime/processor.py
         # collect_counts + runtime/host.py background landing): bytes
         # the blocking counts-only sync moved, landings still queued

@@ -26,6 +26,11 @@ def _durable_replace(tmp: str, dst: str) -> None:
     and fsync the directory after it (the rename itself is a directory
     entry). Without both, a crash-then-power-loss can surface a zero
     -length or missing checkpoint even though the process "wrote" it."""
+    fd = os.open(tmp, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
     os.replace(tmp, dst)
     dir_fd = os.open(os.path.dirname(dst) or ".", os.O_RDONLY)
     try:

@@ -82,6 +82,16 @@ class StreamingHost:
         self.conformance = ConformanceMonitor.from_conf(
             dict_, flow=dict_.get_job_name()
         )
+        # process.debug.protocolmonitor arms the dynamic half of the
+        # DX9xx exactly-once defense (runtime/protocolmonitor.py): the
+        # batch tail records its actual protocol-event sequence and
+        # every sealed batch's linearization is validated against the
+        # declared spec; violations fire runtime DX906
+        from .protocolmonitor import from_conf as _protomon_from_conf
+
+        self.protocol_monitor = _protomon_from_conf(
+            self.processor.process_conf.get_sub_dictionary("debug.")
+        )
 
         input_conf = dict_.get_sub_dictionary(SettingNamespace.JobInputPrefix)
         # one StreamingSource per declared input source (multi-source
@@ -603,6 +613,7 @@ class StreamingHost:
         """The batch tail behind the counts sync: land the
         background-streamed tables, run sinks, commit state, ack
         sources, emit metrics/conformance/alerts, checkpoint."""
+        pm = self.protocol_monitor
         try:
             with trace.activate():
                 land_t0 = time.time()
@@ -611,9 +622,15 @@ class StreamingHost:
                 land_ms = (time.time() - land_t0) * 1000.0
                 with tracing.span("sinks"):
                     self.dispatcher.dispatch(datasets, batch_time_ms)
+                if pm is not None:
+                    pm.record("SINK_EMIT", detail="dispatcher.dispatch")
                 self.processor.commit()
-                for s in self.sources.values():
+                if pm is not None:
+                    pm.record("POINTER_FLIP", detail="processor.commit")
+                for name, s in self.sources.items():
                     s.ack()
+                    if pm is not None:
+                        pm.record("FIFO_ACK", source=name)
         except Exception as e:
             self.telemetry.track_exception(
                 e, {"event": "error/streaming/process", "batchTime": batch_time_ms}
@@ -623,8 +640,12 @@ class StreamingHost:
             )
             trace.end(status="error")
             if requeue_on_error:
-                for s in self.sources.values():
+                for name, s in self.sources.items():
                     s.requeue_unacked()
+                    if pm is not None:
+                        pm.record("REQUEUE", source=name)
+            if pm is not None:
+                pm.seal_batch(batch_time_ms, failed=True)
             logger.exception("batch processing failed; rethrowing for retry")
             raise
 
@@ -699,6 +720,10 @@ class StreamingHost:
                 metrics["Profiler_Captures_Count"] = float(
                     self.profiler.captures_count
                 )
+        if pm is not None:
+            # Protocol_Events_Count for this batch's recorded prefix
+            # (the post-ack checkpoint trio drains on the next batch)
+            metrics.update(pm.drain_metric_deltas())
         self.telemetry.batch_end(batch_time_ms, {"latencyMs": metrics["Latency-Batch"]})
         self.metric_logger.send_batch_metrics(metrics, batch_time_ms)
         # alert evaluation AFTER the store flush so window aggregates
@@ -741,6 +766,23 @@ class StreamingHost:
                 except Exception:  # noqa: BLE001 — telemetry never kills a batch
                     logger.exception("sanitizer event emit failed")
                 logger.warning("buffer sanitizer %s", ev.get("message"))
+        # runtime DX906: protocol-monitor ordering violations from
+        # previously sealed batches join the recorder the same way
+        if pm is not None:
+            for ev in pm.drain_events():
+                try:
+                    self.telemetry.track_event("protocol/violation", ev)
+                    self.metric_logger.send_metric_events(
+                        "Protocol_Violation", [ev], batch_time_ms
+                    )
+                except Exception:  # noqa: BLE001 — telemetry never kills a batch
+                    logger.exception("protocol event emit failed")
+                logger.warning("protocol monitor %s", ev.get("message"))
+        # dx-proto: post-commit at-least-once replay cursor: the window
+        # snapshot + offset commit run AFTER the ack on purpose — a
+        # crash between ack and checkpoint replays from the previous
+        # offsets into rings that already hold the events (duplicates,
+        # never loss)
         if self.checkpointer and (
             t0 - self._last_checkpoint >= self.checkpoint_interval_s
         ):
@@ -762,15 +804,31 @@ class StreamingHost:
                             snap, self.processor.window_buffers
                         )
                     self.window_checkpointer.save(snap)
+                    if pm is not None:
+                        pm.record(
+                            "DURABLE_WRITE",
+                            detail="window_checkpointer.save",
+                        )
                     if self.processor.state_mirror is not None:
                         # ship the owned window partitions (A/B + pointer
                         # per partition) so a rescale successor can pull
                         # exactly its assigned range — fail-closed: a
                         # dead store fails the batch, which requeues
                         self.processor.push_window_partitions(snap)
+                        if pm is not None:
+                            pm.record(
+                                "STATE_PUSH",
+                                detail="push_window_partitions",
+                            )
                 self.checkpointer.checkpoint_batch(consumed)
+                if pm is not None:
+                    pm.record(
+                        "OFFSET_COMMIT", detail="checkpoint_batch",
+                    )
             self._last_checkpoint = t0
             self.health.record_checkpoint()
+        if pm is not None:
+            pm.seal_batch(batch_time_ms)
         self.batches_processed += 1
         self.health.record_batch(
             batch_time_ms, ok=True, latency_ms=metrics["Latency-Batch"]

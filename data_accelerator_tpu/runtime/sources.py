@@ -343,6 +343,9 @@ class BlobPointerSource(StreamingSource):
         self.inner.start(positions)
 
     def ack(self) -> None:
+        # dx-proto: requeue-upstream delegating wrapper: the host's
+        # batch tail owns the failure handler and requeues via
+        # requeue_unacked() below
         self.inner.ack()
 
     def requeue_unacked(self) -> None:

@@ -144,6 +144,17 @@ class FlowOperation:
 
         return analyze_flow_race(flow)
 
+    def validate_flow_protocol(self, flow: dict):
+        """The protocol tier of ``flow/validate`` (``protocol: true``):
+        the DX90x exactly-once delivery gate over the engine modules
+        plus the rescale handoff (``serve/jobs.py``) — typed effect
+        traces checked against the declared ordering spec, cached per
+        engine-source state. Same implementation as the CLI's
+        ``--protocol``; nothing executes."""
+        from ..analysis import analyze_flow_protocol
+
+        return analyze_flow_protocol(flow)
+
     def validate_flow_fleet(self, flow: dict, spec: Optional[dict] = None):
         """The fleet tier of ``flow/validate`` (``fleet: true``): the
         candidate flow is analyzed AS A SET with every currently

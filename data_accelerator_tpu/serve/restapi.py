@@ -292,8 +292,13 @@ class DataXApi:
         buffer-lifetime/concurrency tier (the CLI's ``--race``): the
         DX8xx lints over the ENGINE modules the flow deploys onto,
         merged into the diagnostics plus a ``race`` section (modules
-        analyzed, pinned zero-copy sites, owner handoffs). ``"all":
-        true`` runs every tier in one call — one merged report, one
+        analyzed, pinned zero-copy sites, owner handoffs).
+        ``"protocol": true`` adds the exactly-once delivery-protocol
+        tier (the CLI's ``--protocol``): the DX90x ordering lints over
+        the engine modules plus the rescale handoff, merged into the
+        diagnostics plus a ``protocol`` section (modules analyzed,
+        effect events, pinned post-commit / requeue-upstream sites).
+        ``"all": true`` runs every tier in one call — one merged report, one
         ``schemaVersion``, the CI single-invocation path."""
         flow = body.get("flow") or body.get("gui")
         if flow is None and (body.get("flowName") or body.get("name")) \
@@ -311,8 +316,9 @@ class DataXApi:
         want_compile = all_tiers or body.get("compile")
         want_mesh = all_tiers or body.get("mesh")
         want_race = all_tiers or body.get("race")
+        want_protocol = all_tiers or body.get("protocol")
         if not (want_device or want_udfs or want_fleet or want_compile
-                or want_mesh or want_race):
+                or want_mesh or want_race or want_protocol):
             return report.to_dict()
         from ..analysis import (
             ChipCountError,
@@ -352,9 +358,13 @@ class DataXApi:
         race = (
             self.flow_ops.validate_flow_race(flow) if want_race else None
         )
+        protocol = (
+            self.flow_ops.validate_flow_protocol(flow)
+            if want_protocol else None
+        )
         return combined_report_dict(
             report, device, udfs, fleet, compile_surface=comp, mesh=mesh,
-            race=race,
+            race=race, protocol=protocol,
         )
 
     def _flow_generate(self, body, query):

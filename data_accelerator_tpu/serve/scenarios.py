@@ -258,6 +258,10 @@ def _build_chaos_host(ctx, name: str, pilot: bool, depth: int = 2,
         # escaped pooled/donated view would surface, and the drills
         # assert it stays silent (zero DX805 poison hits)
         "datax.job.process.debug.buffersanitizer": "true",
+        # ... and with the protocol monitor armed: the same churn is
+        # where an ack-before-durability reorder would surface, and
+        # the drills assert zero DX906 protocol violations
+        "datax.job.process.debug.protocolmonitor": "true",
         "datax.job.process.telemetry.tracefile": os.path.join(
             workdir, "trace.jsonl"
         ),
@@ -708,6 +712,9 @@ def _build_stateful_host(ctx, name: str, pilot: bool, depth: int,
         # every drill runs with the DX805 buffer sanitizer armed: the
         # rescale handoff churn must not leak a pooled/donated view
         "datax.job.process.debug.buffersanitizer": "true",
+        # ... and the DX906 protocol monitor: the successor host must
+        # hold the delivery ordering batch by batch too
+        "datax.job.process.debug.protocolmonitor": "true",
         "datax.job.process.telemetry.tracefile": os.path.join(
             workdir, "trace.jsonl"
         ),

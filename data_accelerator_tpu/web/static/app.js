@@ -270,6 +270,18 @@ route("#/flow/", async (view, hash) => {
       `${rc.allowedZeroCopySites} pinned zero-copy site(s), ` +
       `${rc.ownerHandoffSites} owner handoff(s)`);
   };
+  const renderProtocolGate = (pc) => {
+    // protocol tier (flow/validate protocol: true): the DX90x
+    // exactly-once delivery gate over the engine + rescale handoff —
+    // like the race gate, an error here is an engine bug (merged
+    // DX90x diagnostics render above)
+    if (!pc || !pc.analyzedFiles) return null;
+    return h("div", { class: "muted" },
+      `protocol gate: ${pc.analyzedFiles} engine module(s) analyzed — ` +
+      `${pc.effectEvents} effect event(s), ` +
+      `${pc.postCommitSites} pinned post-commit site(s), ` +
+      `${pc.requeueUpstreamSites} requeue-upstream site(s)`);
+  };
   const renderDiags = (r) => {
     diagBox.replaceChildren(
       h("div", { class: "muted" },
@@ -283,6 +295,7 @@ route("#/flow/", async (view, hash) => {
       renderUdfSummary(r.udfs),
       renderCompileSurface(r.compile),
       renderRaceGate(r.race),
+      renderProtocolGate(r.protocol),
       renderCostTable(r.device),
       renderShardingTable(r.mesh),
       renderPlacement(r.fleet));
@@ -290,7 +303,8 @@ route("#/flow/", async (view, hash) => {
   const validate = async () => {
     await save();
     // all: true = every analysis tier in one call (semantic + device +
-    // udfs + fleet + compile + mesh + race), one merged diagnostics list
+    // udfs + fleet + compile + mesh + race + protocol), one merged
+    // diagnostics list
     const r = await api("POST", "/api/flow/flow/validate",
       { flow: gui, all: true });
     renderDiags(r);

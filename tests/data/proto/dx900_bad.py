@@ -1,0 +1,30 @@
+"""BAD twin — DX900: the upstream FIFO is acked BEFORE the durable
+pointer flip (the exact ack-before-checkpoint reorder the dynamic
+half of tests/test_recovery.py seeds into a live StreamingHost), plus
+an os.replace with neither fsync of the durability fence.
+
+A crash between the ack and the flip loses the batch: the FIFO has
+released the window, the state tables still point at the old side.
+"""
+
+import os
+
+
+class MiniHost:
+    """A batch tail that acks before committing."""
+
+    def finish_tail(self, datasets, batch_time_ms):
+        try:
+            self.dispatcher.dispatch(datasets, batch_time_ms)
+            for name, s in self.sources.items():
+                s.ack()
+            self.processor.commit()
+        except Exception:
+            for name, s in self.sources.items():
+                s.requeue_unacked()
+            raise
+
+
+def unsafe_replace(tmp, dst):
+    """A checkpoint rename with no durability fence at all."""
+    os.replace(tmp, dst)

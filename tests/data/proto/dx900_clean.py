@@ -1,0 +1,35 @@
+"""CLEAN twin — DX900: sink emit, then the durable pointer flip,
+then the FIFO ack; the checkpoint rename is fenced by an fsync of the
+tmp file before it and of the parent directory after it.
+"""
+
+import os
+
+
+class MiniHost:
+    """A batch tail in the shipped StreamingHost order."""
+
+    def finish_tail(self, datasets, batch_time_ms):
+        try:
+            self.dispatcher.dispatch(datasets, batch_time_ms)
+            self.processor.commit()
+            for name, s in self.sources.items():
+                s.ack()
+        except Exception:
+            for name, s in self.sources.items():
+                s.requeue_unacked()
+            raise
+
+
+def durable_replace(tmp, dst):
+    fd = os.open(tmp, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, dst)
+    dir_fd = os.open(os.path.dirname(dst) or ".", os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)

@@ -1,0 +1,20 @@
+"""BAD twin — DX901: the state-table pointer flips BEFORE the sinks
+accepted the batch. A sink failure now leaves committed state for
+rows no sink ever received; the requeued batch replays into state
+that already counted it — double counting, the reverse of loss.
+"""
+
+
+class MiniHost:
+    """A batch tail that commits state before dispatching sinks."""
+
+    def finish_tail(self, datasets, batch_time_ms):
+        try:
+            self.processor.commit()
+            self.dispatcher.dispatch(datasets, batch_time_ms)
+            for name, s in self.sources.items():
+                s.ack()
+        except Exception:
+            for name, s in self.sources.items():
+                s.requeue_unacked()
+            raise

@@ -1,0 +1,16 @@
+"""CLEAN twin — DX901: sinks first, pointer flip second — the
+shipped order (StreamingHost._finish_tail and the BatchHost landing
+tail both establish it)."""
+
+
+class MiniHost:
+    def finish_tail(self, datasets, batch_time_ms):
+        try:
+            self.dispatcher.dispatch(datasets, batch_time_ms)
+            self.processor.commit()
+            for name, s in self.sources.items():
+                s.ack()
+        except Exception:
+            for name, s in self.sources.items():
+                s.requeue_unacked()
+            raise

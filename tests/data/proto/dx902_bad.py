@@ -1,0 +1,19 @@
+"""BAD twin — DX902: two ack call sites on one batch path. The
+second ack releases the primary source's window a second time — if
+the first ack raced a failure, the requeue the handler issued is
+silently undone.
+"""
+
+
+class MiniHost:
+    def finish_tail(self, datasets, batch_time_ms):
+        try:
+            self.dispatcher.dispatch(datasets, batch_time_ms)
+            self.processor.commit()
+            for name, s in self.sources.items():
+                s.ack()
+            self.primary.ack()
+        except Exception:
+            for name, s in self.sources.items():
+                s.requeue_unacked()
+            raise

@@ -1,0 +1,15 @@
+"""CLEAN twin — DX902: exactly one ack loop per batch tail; every
+source is released once, by the same commit point."""
+
+
+class MiniHost:
+    def finish_tail(self, datasets, batch_time_ms):
+        try:
+            self.dispatcher.dispatch(datasets, batch_time_ms)
+            self.processor.commit()
+            for name, s in self.sources.items():
+                s.ack()
+        except Exception:
+            for name, s in self.sources.items():
+                s.requeue_unacked()
+            raise

@@ -1,0 +1,18 @@
+"""BAD twin — DX903: the ack loop covers every source, but the
+failure handler requeues only the primary. A multi-source batch that
+fails after partial processing strands the other sources' polled
+windows: never acked, never requeued, redelivered only after a
+restart (or never, for session-scoped FIFOs).
+"""
+
+
+class MiniHost:
+    def finish_tail(self, datasets, batch_time_ms):
+        try:
+            self.dispatcher.dispatch(datasets, batch_time_ms)
+            self.processor.commit()
+            for name, s in self.sources.items():
+                s.ack()
+        except Exception:
+            self.primary.requeue_unacked()
+            raise

@@ -1,0 +1,15 @@
+"""CLEAN twin — DX903: the failure handler requeues the SAME window
+the ack loop covers — every source, not just the primary."""
+
+
+class MiniHost:
+    def finish_tail(self, datasets, batch_time_ms):
+        try:
+            self.dispatcher.dispatch(datasets, batch_time_ms)
+            self.processor.commit()
+            for name, s in self.sources.items():
+                s.ack()
+        except Exception:
+            for name, s in self.sources.items():
+                s.requeue_unacked()
+            raise

@@ -1,0 +1,21 @@
+"""BAD twin — DX904: effects outside the requeue scope. The window
+snapshot is written BEFORE the guarded try (a failure after it
+strands a snapshot of a batch that will be requeued and replayed),
+and the offset commit after the ack is undeclared — nothing pins the
+fact that the replay cursor is intentionally at-least-once.
+"""
+
+
+class MiniHost:
+    def finish_tail(self, datasets, consumed, batch_time_ms):
+        self.window_checkpointer.save(self.snap)
+        try:
+            self.dispatcher.dispatch(datasets, batch_time_ms)
+            self.processor.commit()
+            for name, s in self.sources.items():
+                s.ack()
+        except Exception:
+            for name, s in self.sources.items():
+                s.requeue_unacked()
+            raise
+        self.checkpointer.checkpoint_batch(consumed)

@@ -1,0 +1,20 @@
+"""CLEAN twin — DX904: every pre-ack effect sits inside the try
+whose handler requeues, and the post-ack offset commit carries the
+explicit post-commit marker declaring the at-least-once tail."""
+
+
+class MiniHost:
+    def finish_tail(self, datasets, consumed, batch_time_ms):
+        try:
+            self.window_checkpointer.save(self.snap)
+            self.dispatcher.dispatch(datasets, batch_time_ms)
+            self.processor.commit()
+            for name, s in self.sources.items():
+                s.ack()
+        except Exception:
+            for name, s in self.sources.items():
+                s.requeue_unacked()
+            raise
+        # dx-proto: post-commit offsets trail the ack on purpose — a
+        # crash here replays into rings that already hold the events
+        self.checkpointer.checkpoint_batch(consumed)

@@ -1,0 +1,15 @@
+"""BAD twin — DX905: the rescale submits the successor job BEFORE
+pulling its owned-partition plan. The new replica boots with no
+statePartitionsOwned assignment: it pulls nothing from the mirror and
+rebuilds its windows from empty rings — silent state loss across the
+handoff.
+"""
+
+
+class MiniJobOperation:
+    def rescale(self, base, replicas):
+        rec = dict(base)
+        rec = self.client.submit(rec)
+        pmap = self._state_partition_plan(base, replicas)
+        rec["statePartitionsOwned"] = sorted(pmap.get(0, []))
+        return rec

@@ -1,0 +1,11 @@
+"""CLEAN twin — DX905: plan first, stamp the record, submit last —
+the shipped JobOperation.rescale order."""
+
+
+class MiniJobOperation:
+    def rescale(self, base, replicas):
+        rec = dict(base)
+        pmap = self._state_partition_plan(base, replicas)
+        rec["statePartitionsOwned"] = sorted(pmap.get(0, []))
+        rec = self.client.submit(rec)
+        return rec

@@ -153,6 +153,7 @@ def test_every_registered_code_has_a_golden_fixture():
     from test_compilecheck import COMPILE_GOLDEN
     from test_fleetcheck import FLEET_GOLDEN
     from test_meshcheck import MESH_GOLDEN
+    from test_protocheck import PROTO_CODES
     from test_racecheck import RACE_CODES
 
     assert (
@@ -163,6 +164,7 @@ def test_every_registered_code_has_a_golden_fixture():
         | {g[1] for g in COMPILE_GOLDEN}
         | {g[1] for g in MESH_GOLDEN}
         | set(RACE_CODES)
+        | set(PROTO_CODES)
     ) == set(CODES)
 
 
@@ -438,6 +440,18 @@ def test_json_reports_pin_schema_version_and_keys(tmp_path):
         "ownerHandoffSites",
     }
     assert set(out["race"]["modules"][0]) == {"path", "functions"}
+
+    # protocol tier (schemaVersion 4: the exactly-once delivery gate)
+    out = json.loads(_run_cli(["--json", "--protocol", path]).stdout)
+    assert out["schemaVersion"] == REPORT_SCHEMA_VERSION
+    assert set(out) == base_keys | {"file", "protocol"}
+    assert set(out["protocol"]) == {
+        "flow", "analyzedFiles", "modules", "effectEvents",
+        "postCommitSites", "requeueUpstreamSites",
+    }
+    assert set(out["protocol"]["modules"][0]) == {
+        "path", "functions", "events",
+    }
 
 
 def test_validate_endpoint_reports_carry_schema_version(flow_ops):

@@ -54,6 +54,15 @@ def _run(factory, pilot, tmp_path):
     assert san is not None and san.poison_hits == 0, (
         f"{scenario.name}: sanitizer hits {san.drain_events()}"
     )
+    # ... and with the protocol monitor armed: every sealed batch's
+    # event linearization held the exactly-once ordering (zero DX906)
+    pm = ctx["host"].protocol_monitor
+    assert pm is not None and pm.violations == 0, (
+        f"{scenario.name}: protocol violations {pm.drain_events()}"
+    )
+    assert pm.batches_sealed > 0, (
+        f"{scenario.name}: monitor armed but sealed no batches"
+    )
     return ctx, result
 
 

@@ -242,6 +242,19 @@ def test_sanitizer_metrics_are_registered():
     assert not MetricName.is_runtime_metric("Sanitizer_Bogus")
 
 
+def test_protocol_monitor_metrics_are_registered():
+    """The protocol monitor's series (runtime/protocolmonitor.py,
+    drained into each batch's metric bundle) resolve through the
+    registry; emission-side coverage is tests/test_protocheck.py and
+    the seeded regression in tests/test_recovery.py."""
+    for m in (
+        "Protocol_Events_Count",
+        "Protocol_Violation_Count",
+    ):
+        assert MetricName.is_runtime_metric(m), m
+    assert not MetricName.is_runtime_metric("Protocol_Bogus")
+
+
 def test_lq_serving_metrics_are_registered():
     """Every LQ_* / Latency-LQExec series the LiveQuery serving plane
     emits (lq/service.py export_metrics under DATAX-LiveQuery) resolves

@@ -303,6 +303,10 @@ def _depth_host(tmp_path, depth):
         # churn at depth 2/4 is exactly where an escaped pooled view
         # would surface, and the suite asserts it stays silent
         "datax.job.process.debug.buffersanitizer": "true",
+        # the protocol monitor rides along too: every sealed batch
+        # (including the poisoned/requeued ones) must linearize to the
+        # declared sink -> flip -> ack ordering
+        "datax.job.process.debug.protocolmonitor": "true",
         "datax.job.output.Out.console.maxrows": "0",
     })
     src = SocketSource(port=0)
@@ -628,3 +632,115 @@ def test_profiler_hook_writes_trace(tmp_path):
     for root, _d, files in os.walk(res["path"]):
         traces += [f for f in files if "trace" in f or f.endswith(".pb")]
     assert traces, f"no profiler trace written under {res['path']}"
+
+
+# ---------------------------------------------------------------------------
+# the seeded PR 18 regression: the SAME ack-before-checkpoint reorder
+# of StreamingHost's batch tail is caught by BOTH halves of the DX9xx
+# protocol gate — statically (analysis/protocheck.py names the
+# function) and dynamically (the armed ProtocolMonitor fires DX906
+# under sink-failure injection, exactly once)
+# ---------------------------------------------------------------------------
+_SEEDED_REORDER_SRC = '''\
+class StreamingHost:
+    def _finish(self, handle, batch_time_ms):
+        try:
+            datasets, metrics = handle.collect_tables()
+            for name, s in self.sources.items():
+                s.ack()
+            self.dispatcher.dispatch(datasets, batch_time_ms)
+            self.processor.commit()
+        except Exception:
+            for name, s in self.sources.items():
+                s.requeue_unacked()
+            raise
+'''
+
+
+def test_seeded_ack_reorder_caught_statically(tmp_path):
+    """The static half: a StreamingHost whose tail acks the FIFO first
+    (the seeded reorder below, verbatim) analyzes to DX900 naming
+    StreamingHost._finish — plus the DX904 rider on the now-post-ack
+    sink emit. The protocol gate fails this source before it ships."""
+    from data_accelerator_tpu.analysis import analyze_proto_modules
+
+    seeded = tmp_path / "seeded_host.py"
+    seeded.write_text(_SEEDED_REORDER_SRC)
+    report = analyze_proto_modules([str(seeded)])
+    assert not report.ok
+    assert {d.code for d in report.diagnostics} == {"DX900", "DX904"}
+    (dx900,) = [d for d in report.diagnostics if d.code == "DX900"]
+    assert "StreamingHost._finish" in dx900.message
+    assert "before the durable pointer flip" in dx900.message
+
+
+def test_seeded_ack_reorder_caught_dynamically_by_monitor(tmp_path):
+    """The dynamic half: bind the SAME reorder onto a live host (ack
+    before dispatch/commit), poison the sink, run one batch. The acked
+    FIFO has nothing left to requeue — the classic lost-batch bug —
+    and the armed ProtocolMonitor convicts it: the failed batch seals
+    to [FIFO_ACK, REQUEUE] and fires EXACTLY ONE DX906 citing DX900."""
+    import types
+
+    host, src, sink = _depth_host(tmp_path, depth=1)
+
+    def _reordered_tail(self, handle, consumed, batch_time_ms, t0,
+                        trace, inflight_depth, stall_ms, backlog,
+                        requeue_on_error=True):
+        pm = self.protocol_monitor
+        try:
+            with trace.activate():
+                datasets, _metrics = handle.collect_tables()
+                for name, s in self.sources.items():
+                    s.ack()  # the seeded bug: ack FIRST
+                    if pm is not None:
+                        pm.record("FIFO_ACK", source=name)
+                self.dispatcher.dispatch(datasets, batch_time_ms)
+                if pm is not None:
+                    pm.record("SINK_EMIT", detail="dispatcher.dispatch")
+                self.processor.commit()
+                if pm is not None:
+                    pm.record("POINTER_FLIP", detail="processor.commit")
+        except Exception:
+            trace.end(status="error")
+            if requeue_on_error:
+                for name, s in self.sources.items():
+                    s.requeue_unacked()
+                    if pm is not None:
+                        pm.record("REQUEUE", source=name)
+            if pm is not None:
+                pm.seal_batch(batch_time_ms, failed=True)
+            raise
+        if pm is not None:
+            pm.seal_batch(batch_time_ms)
+        self.batches_processed += 1
+        return {}
+
+    host._finish_tail = types.MethodType(_reordered_tail, host)
+    try:
+        _feed_socket(src, 4)  # one batch (k 0-3)
+        sink.poison_k = 1     # fails at the sink — AFTER the ack
+        pm = host.protocol_monitor
+        assert pm is not None  # armed by _depth_host's conf
+        with pytest.raises(RuntimeError, match="poisoned"):
+            host.run_pipelined(max_batches=1)
+        # the monitor convicted the reorder on the failed batch
+        assert pm.violations == 1
+        assert pm.batches_sealed == 1
+        events = pm.drain_events()
+        assert len(events) == 1, events
+        ev = events[0]
+        assert ev["code"] == "DX906"
+        assert ev["rule"] == "DX900"
+        assert ev["failed"] is True
+        # the pipelined window requeues at the WINDOW level after the
+        # tail seals (host.run_pipelined's except), so the sealed
+        # linearization is the bare premature ack
+        assert ev["sequence"] == ["FIFO_ACK"]
+        assert "FAILED batch" in ev["message"]
+        # and the bug is REAL: the acked FIFO had nothing to requeue,
+        # so the poisoned batch is gone (the loss DX900 predicts)
+        blob, n, _ = src.poll_raw(4)
+        assert n == 0 and not blob.strip()
+    finally:
+        host.stop()
