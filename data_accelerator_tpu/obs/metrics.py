@@ -50,8 +50,18 @@ class MetricLogger:
             from .ingestor import MetricStreamSender
 
             eventhub_sender = MetricStreamSender(h or "127.0.0.1", int(p))
+        # the redis-analog sink: unset or any connection-ish value keeps
+        # the shared in-proc MetricStore (the one-box stand-in for the
+        # reference's Redis — the dashboard reads it back); an explicit
+        # disable word detaches the job from the dashboard feed, the
+        # analog of a reference job deployed with no redis connection
+        redis = (sub.get("redis") or "").strip().lower()
+        store = MetricStore() if redis in (
+            "false", "off", "none", "disabled", "0",
+        ) else None
         return MetricLogger(
             metric_app_name=dict_.get_metric_app_name(),
+            store=store,
             http_endpoint=sub.get("httppost"),
             eventhub_sender=eventhub_sender,
         )

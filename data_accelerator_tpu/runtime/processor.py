@@ -622,6 +622,24 @@ class FlowProcessor:
         input_conf = dict_.get_sub_dictionary(SettingNamespace.JobInputPrefix)
         process_conf = dict_.get_sub_dictionary(SettingNamespace.JobProcessPrefix)
         self.process_conf = process_conf
+        # designer chip count (jobNumChips -> guiJobNumChips -> S650
+        # process.numchips): honored when no mesh was passed in,
+        # clamped to the locally visible devices so a conf generated
+        # for an 8-chip slice still boots on a one-box dev host (a
+        # clamp to 1 keeps the packed single-device path).
+        if self.mesh is None:
+            chips = process_conf.get_int_option("numchips")
+            if chips is not None and chips > 1:
+                from ..dist.mesh import make_mesh
+
+                n = min(chips, len(jax.devices()))
+                if n > 1:
+                    if n < chips:
+                        logger.warning(
+                            "process.numchips=%d clamped to %d visible "
+                            "devices", chips, n,
+                        )
+                    self.mesh = make_mesh(n)
 
         # sanitizer wiring — the runtime counterpart of the DX3xx UDF
         # analyzer: conf process.debug.nans / process.debug.tracerleaks
@@ -678,7 +696,7 @@ class FlowProcessor:
         self.sized_transfer = (
             (pipe_conf.get_or_else("sizedtransfer", "true") or "").lower()
             != "false"
-        ) and mesh is None
+        ) and self.mesh is None
         # per-output EWMA of observed valid row counts — the sized
         # transfer capacity tracks this, bucketed to powers of two
         self.transfer_ewma: Dict[str, float] = {}
@@ -694,7 +712,7 @@ class FlowProcessor:
         self.output_slots_enabled = (
             (pipe_conf.get_or_else("outputslots", "true") or "").lower()
             != "false"
-        ) and mesh is None
+        ) and self.mesh is None
         # observed mesh communication (datax.job.process.mesh.observe,
         # default on): under a mesh the compiled step's collective
         # census (dist/mesh.py collective_summary) exports per batch as
@@ -708,7 +726,7 @@ class FlowProcessor:
                 process_conf.get_sub_dictionary("mesh.")
                 .get_or_else("observe", "true") or ""
             ).lower() != "false"
-        ) and mesh is not None
+        ) and self.mesh is not None
         # None = not yet censused; False = census failed (don't retry
         # every batch); else a dist.mesh.MeshCollectives
         self.mesh_collectives = None
