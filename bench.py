@@ -778,6 +778,55 @@ def bench_protocheck(iters=200):
     }
 
 
+def bench_confcheck(iters=50):
+    """Conf-gate cost block: the static DX10xx tier's analysis latency
+    over the engine+serve packages (cold AST scan vs the mtime-keyed
+    cache hit the CLI/REST/CI path normally takes) and the runtime
+    ConfAudit's boot cost over a fully populated conf (every registry
+    default — the worst realistic key count a host boots with). The
+    cold number is gated in ``regression``: the conf gate rides every
+    CI validate call, so its cost is a committed number. ``findings``
+    doubles as a live engine check — any nonzero means the tree
+    itself broke the conf lattice."""
+    from data_accelerator_tpu.analysis.confcheck import (
+        _ENGINE_CACHE,
+        analyze_flow_conf,
+    )
+    from data_accelerator_tpu.analysis.confspec import (
+        CONF_REGISTRY,
+        PROCESS_PREFIX,
+    )
+    from data_accelerator_tpu.runtime.confaudit import audit_conf
+
+    _ENGINE_CACHE.clear()
+    t0 = time.perf_counter()
+    report = analyze_flow_conf({"name": "Bench"})
+    cold_ms = (time.perf_counter() - t0) * 1000.0
+    t0 = time.perf_counter()
+    analyze_flow_conf({"name": "Bench"})
+    cached_ms = (time.perf_counter() - t0) * 1000.0
+
+    conf = {
+        PROCESS_PREFIX + e.key: e.default
+        for e in CONF_REGISTRY
+        if e.default is not None and "*" not in e.key
+    }
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        audit = audit_conf(conf)
+    audit_us = (time.perf_counter() - t0) / iters * 1e6
+    return {
+        "cold_ms": round(cold_ms, 2),
+        "cached_ms": round(cached_ms, 3),
+        "analyzed_files": report.analyzed_files,
+        "read_sites": len(report.read_sites),
+        "registry_keys": len(CONF_REGISTRY),
+        "audit_keys": audit.audited,
+        "audit_init_us": round(audit_us, 1),
+        "findings": len(report.diagnostics) + len(audit.findings),
+    }
+
+
 def bench_pilot_overhead(iters=2000):
     """Autopilot hot-path overhead block: the pilot rides the dispatch
     loop (``tick`` per iteration, ``admit_events`` + ``observe_poll``
@@ -1138,6 +1187,10 @@ def regression_gate(current: dict, tolerance: float = 0.10):
     # cached path is sub-ms and too jittery to gate; it is published
     # in the block instead.)
     d_proto_cold = nested_delta("protocheck", "cold_ms")
+    # conf-gate cost: same contract as the protocol gate — the cold
+    # lattice scan rides every CI validate call, so a >band worsening
+    # fails; the cached/audit paths are sub-ms and published only
+    d_conf_cold = nested_delta("confcheck", "cold_ms")
     # cold-start gate: warm time-to-first-batch is the restart/
     # preemption-recovery promise — a >band worsening (or warm no
     # longer beating cold at all) fails like an events/s drop
@@ -1175,6 +1228,13 @@ def regression_gate(current: dict, tolerance: float = 0.10):
             bool(current.get("protocheck"))
             and current["protocheck"].get("violations", 0) != 0
         )
+        or (d_conf_cold is not None and d_conf_cold > tolerance)
+        # acceptance bit: the engine tree + the fully populated boot
+        # conf must pass its own lattice clean
+        or (
+            bool(current.get("confcheck"))
+            and current["confcheck"].get("findings", 0) != 0
+        )
     )
     return {
         "baseline": os.path.basename(latest),
@@ -1186,6 +1246,7 @@ def regression_gate(current: dict, tolerance: float = 0.10):
         "lq_kernel_qps_delta": d_lq_qps,
         "lq_p99_exec_delta": d_lq_p99,
         "protocheck_cold_delta": d_proto_cold,
+        "confcheck_cold_delta": d_conf_cold,
         "fleet_publish_delta": d_fleet_pub,
         "fleet_merge_delta": d_fleet_merge,
         "tolerance": tolerance,
@@ -1374,6 +1435,11 @@ def main():
         # the cold number is regression-gated (it rides every CI
         # validate call)
         "protocheck": bench_protocheck(),
+        # the DX10xx conf gate: static lattice-scan latency (cold vs
+        # the mtime cache hit) and the DX1006 ConfAudit's boot cost;
+        # the cold number is regression-gated (it rides every CI
+        # validate call)
+        "confcheck": bench_confcheck(),
         "pilot": bench_pilot_overhead(),
         # the "millions of users" axis: interactive kernel QPS + p99
         # exec latency under multi-tenant open-loop load, published

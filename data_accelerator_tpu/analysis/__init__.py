@@ -43,7 +43,14 @@ Eight tiers:
   checked against the declared ordering spec (``protospec.py``), the
   DX90x durability/ordering/requeue/handoff lints (``protocheck.py``);
   its dynamic counterpart is ``runtime/protocolmonitor.py`` (runtime
-  DX906, conf ``process.debug.protocolmonitor``).
+  DX906, conf ``process.debug.protocolmonitor``);
+- the conf tier (``analyze_flow_conf``): the configuration lattice —
+  every engine conf read site and every generation-produced key
+  checked against the ONE typed registry (``confspec.py``), the
+  DX10xx dead-knob / dead-conf / broken-chain / default-drift /
+  type-bounds / incompatible-knob lints (``confcheck.py``); its
+  dynamic counterpart is ``runtime/confaudit.py`` (runtime DX1006,
+  armed at every host/LQ-service init).
 
 CLI: ``python -m data_accelerator_tpu.analysis flow.json [--json]
 [--device [--chips N]] [--udfs] [--fleet [--fleet-spec=spec.json]]
@@ -105,6 +112,17 @@ from .meshcheck import (
     analyze_flow_mesh,
     analyze_processor_mesh,
 )
+from .confcheck import (
+    ConfCheckReport,
+    analyze_conf_modules,
+    analyze_flow_conf,
+    conf_module_paths,
+)
+from .confspec import (
+    CONF_REGISTRY,
+    ConfKey,
+    check_conf_mapping,
+)
 from .protocheck import (
     PROTO_EXTRA_MODULES,
     ProtoCheckReport,
@@ -141,6 +159,9 @@ __all__ = [
     "CODES",
     "ChipCountError",
     "CompileSurfaceReport",
+    "CONF_REGISTRY",
+    "ConfCheckReport",
+    "ConfKey",
     "MANIFEST_VERSION",
     "DEFAULT_CHIPS",
     "DEFAULT_FLEET_CHIPS",
@@ -179,7 +200,9 @@ __all__ = [
     "analyze_fleet",
     "analyze_fleet_flows",
     "analyze_flow",
+    "analyze_conf_modules",
     "analyze_flow_compile",
+    "analyze_flow_conf",
     "analyze_flow_device",
     "analyze_flow_mesh",
     "analyze_flow_protocol",
@@ -195,6 +218,8 @@ __all__ = [
     "check_udf_object",
     "combined_report_dict",
     "check_sequence",
+    "check_conf_mapping",
+    "conf_module_paths",
     "engine_module_paths",
     "flow_footprint",
     "proto_module_paths",

@@ -4,7 +4,7 @@
         [--json] [--device] [--chips=N] [--udfs]
         [--fleet] [--fleet-spec=spec.json]
         [--compile] [--manifest=m.json] [--manifest-out=m.json]
-        [--mesh] [--race] [--protocol] [--all]
+        [--mesh] [--race] [--protocol] [--conf] [--all]
 
 Each argument is a flow config file: either a designer gui JSON or a
 full flow document (``{"gui": {...}}``). Prints one line per diagnostic
@@ -85,10 +85,24 @@ pull (DX905). Cached per engine-source state; same exit contract —
 this is the CI gate the exchange-plane and drain-protocol work builds
 behind.
 
+``--conf`` runs the configuration-lattice tier
+(``analysis/confcheck.py``): both sides of the flow's conf contract —
+the ENGINE side (every ``conf.get`` site in the runtime/serving
+packages) and the GENERATION side (S400 gui tokens, S640 knob tables,
+S650 flat keys, the flattener template) — are scanned and checked
+against the ONE typed registry in ``analysis/confspec.py``, emitting
+the DX10xx lints: runtime reads nothing can produce (DX1000),
+generated-but-never-read dead conf (DX1001), broken designer
+knob→token→key chains (DX1002), default-value drift between layers
+(DX1003), plus type/bounds violations (DX1004) and incompatible-knob
+combinations (DX1005) in THIS flow's effective conf. Cached per
+engine-source state; same exit contract — the runtime half of the
+same registry is the host's ``ConfAudit`` (DX1006).
+
 ``--all`` runs every tier in one invocation (semantic + device + udfs
-+ fleet + compile + mesh + race + protocol) with one merged ``--json``
-report (single ``schemaVersion``, combined diagnostics, same 0/1/2
-exit contract) — one CI call instead of eight flags.
++ fleet + compile + mesh + race + protocol + conf) with one merged
+``--json`` report (single ``schemaVersion``, combined diagnostics,
+same 0/1/2 exit contract) — one CI call instead of nine flags.
 
 Unknown ``--`` flags are rejected with exit 2 (a typo like ``--devcie``
 must not silently skip a tier and report a false clean pass).
@@ -208,7 +222,7 @@ def _print_fleet_plan(fleet) -> None:
 # flags the CLI understands; anything else --prefixed is a usage error
 # (a typo like --devcie must not silently skip a tier)
 KNOWN_FLAGS = {"--json", "--device", "--udfs", "--fleet", "--compile",
-               "--mesh", "--race", "--protocol", "--all"}
+               "--mesh", "--race", "--protocol", "--conf", "--all"}
 KNOWN_VALUE_FLAGS = ("--chips=", "--fleet-spec=", "--manifest=",
                      "--manifest-out=")
 
@@ -226,6 +240,7 @@ def main(argv: List[str]) -> int:
     mesh_tier = "--mesh" in argv or all_tiers
     race_tier = "--race" in argv or all_tiers
     protocol_tier = "--protocol" in argv or all_tiers
+    conf_tier = "--conf" in argv or all_tiers
     chips: Optional[int] = None
     fleet_spec_path: Optional[str] = None
     manifest_path: Optional[str] = None
@@ -279,6 +294,7 @@ def main(argv: List[str]) -> int:
 
     from .analyzer import analyze_flow
     from .compilecheck import analyze_flow_compile
+    from .confcheck import analyze_flow_conf
     from .deviceplan import analyze_flow_device, combined_report_dict
     from .diagnostics import REPORT_SCHEMA_VERSION
     from .meshcheck import analyze_flow_mesh
@@ -334,6 +350,7 @@ def main(argv: List[str]) -> int:
         protocol = (
             analyze_flow_protocol(flow) if protocol_tier else None
         )
+        conf = analyze_flow_conf(flow) if conf_tier else None
         any_errors |= not report.ok
         if device is not None:
             any_errors |= not device.ok
@@ -350,17 +367,21 @@ def main(argv: List[str]) -> int:
             any_errors |= not race.ok
         if protocol is not None:
             any_errors |= not protocol.ok
+        if conf is not None:
+            any_errors |= not conf.ok
         if as_json:
             if (
                 device is not None or udfs is not None
                 or comp is not None or mesh is not None
                 or race is not None or protocol is not None
+                or conf is not None
             ):
                 json_out.append({
                     "file": path,
                     **combined_report_dict(
                         report, device, udfs, compile_surface=comp,
                         mesh=mesh, race=race, protocol=protocol,
+                        conf=conf,
                     ),
                 })
             else:
@@ -374,7 +395,7 @@ def main(argv: List[str]) -> int:
                 list(race.diagnostics) if race is not None else []
             ) + (
                 list(protocol.diagnostics) if protocol is not None else []
-            )
+            ) + (list(conf.diagnostics) if conf is not None else [])
             for d in diags:
                 print(f"{path}: {d.render()}")
             n_e = len([d for d in diags if d.is_error])
@@ -419,6 +440,15 @@ def main(argv: List[str]) -> int:
                     f"{pd['postCommitSites']} pinned post-commit "
                     f"site(s), {pd['requeueUpstreamSites']} "
                     f"requeue-upstream site(s)"
+                )
+            if conf is not None:
+                cf = conf.conf_dict()
+                print(
+                    f"{path}: conf gate: {cf['analyzedFiles']} "
+                    f"module(s) scanned, {cf['readSites']} read "
+                    f"site(s) / {cf['readKeys']} key(s), "
+                    f"{cf['producedKeys']} produced key(s), "
+                    f"{cf['registryKeys']} registry row(s)"
                 )
 
     fleet = None

@@ -261,7 +261,7 @@ def _ordered(diags: List[Diagnostic]) -> List[Diagnostic]:
 def combined_report_dict(
     base: AnalysisReport, device: Optional[DevicePlanReport] = None,
     udfs=None, fleet=None, compile_surface=None, mesh=None, race=None,
-    protocol=None,
+    protocol=None, conf=None,
 ) -> dict:
     """Merge the semantic tier with the optional device, UDF, fleet,
     compile, mesh, race and protocol tiers into one response: a
@@ -292,6 +292,8 @@ def combined_report_dict(
         diags += list(race.diagnostics)
     if protocol is not None:
         diags += list(protocol.diagnostics)
+    if conf is not None:
+        diags += list(conf.diagnostics)
     diags = _ordered(diags)
     errors = [d for d in diags if d.is_error]
     out = {
@@ -315,6 +317,8 @@ def combined_report_dict(
         out["race"] = race.race_dict()
     if protocol is not None:
         out["protocol"] = protocol.protocol_dict()
+    if conf is not None:
+        out["conf"] = conf.conf_dict()
     return out
 
 

@@ -248,6 +248,26 @@ CODES: Dict[str, tuple] = {
               "wrap pre-ack effects in the requeue-guarded try; annotate designed at-least-once tails '# dx-proto: post-commit <reason>' so the inventory pins them"),
     "DX905": (SEV_ERROR, "handoff-pull-before-first-dispatch violated: a rescale dispatches a successor job before pulling/stamping its owned-partition plan, so the replica boots without its state assignment",
               "compute _state_partition_plan and stamp statePartitionsOwned/confOverrides on the record before client.submit"),
+
+    # 11. configuration lattice (analysis/confcheck.py, --conf): the
+    #     designer knob -> S400 token -> S650 flat key -> runtime read
+    #     chain checked against the ONE typed registry in
+    #     analysis/confspec.py. DX1006 is the registry's runtime half
+    #     (runtime/confaudit.py flight-records it at host/LQ init).
+    "DX1000": (SEV_ERROR, "runtime-read-but-never-producible: a conf read site waits on a key no registry row covers — a dead knob or a typo'd key no generation path can produce",
+               "register the key in analysis/confspec.py CONF_REGISTRY (with type/default/chain) or fix the read site's key string"),
+    "DX1001": (SEV_WARNING, "generated-but-never-read: a produced conf key (generation stage, control plane or conf file) matches no registry row, or a registered read=True key has no read site — dead conf",
+               "delete the production, or register the key (read=False for deliberate reference-parity keys)"),
+    "DX1002": (SEV_ERROR, "broken designer->runtime chain: a gui token no generated key carries, or a registered knob whose declared conf key generation never writes — the designer's choice is dropped on the floor",
+               "wire the token through S650/S640 to its registered key (or fix the registry row's knob/key chain)"),
+    "DX1003": (SEV_WARNING, "default-value drift: a read-site fallback or S400 generation default disagrees with the registry's canonical default — 'unset' behaves differently per layer",
+               "align the fallback literal with the registry default (the registry row is the single source of truth)"),
+    "DX1004": (SEV_ERROR, "conf type/bounds violation: a concrete flow conf value fails its registry row's type, bounds or choices (pipeline.depth=0, a negative TTL, an HBM budget above the chip)",
+               "fix the flow's designer knob / conf value to satisfy the registered type and bounds"),
+    "DX1005": (SEV_ERROR, "incompatible conf combination: a declared mutual-exclusion constraint is violated (mesh+sizedtransfer, mesh+backgroundtransfer, state.filteringest without state partitions)",
+               "drop one side of the combination — the constraint table in analysis/confspec.py documents why they cannot compose"),
+    "DX1006": (SEV_ERROR, "live conf failed the registry audit: the host/LQ service booted with an unknown or out-of-bounds datax.job.process.* key (runtime/confaudit.py)",
+               "regenerate the flow's conf (stale key) or fix the out-of-bounds value; the Conf_{Audited,Unknown,OutOfBounds}_Count metrics carry the counts"),
 }
 
 # which pass each code family belongs to (for grouping/reporting)
@@ -269,6 +289,7 @@ PASS_NAMES = {
     "DX79": "mesh sharding",
     "DX80": "buffer lifetime/race",
     "DX90": "delivery protocol",
+    "DX10": "configuration lattice",
 }
 
 # version of every ``--json`` report shape the analysis tiers emit (the
@@ -281,7 +302,9 @@ PASS_NAMES = {
 # lifetime/concurrency gate).
 # v4: the ``protocol`` report block (the --protocol tier's exactly-
 # once delivery-protocol gate).
-REPORT_SCHEMA_VERSION = 4
+# v5: the ``conf`` report block (the --conf tier's configuration-
+# lattice gate: typed registry + designer->runtime chain).
+REPORT_SCHEMA_VERSION = 5
 
 
 def make(code: str, table: str, message: str, span: Optional[Span] = None,

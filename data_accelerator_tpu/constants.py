@@ -87,6 +87,15 @@ class MetricName:
     FLEET_FRAME_PUBLISH_ERROR = "Fleet_FramePublishError_Count"
     FLEET_FRAME_DECODE_ERROR = "Fleet_FrameDecodeError_Count"
     FLEET_MERGE_LATENCY_MS = "Fleet_MergeLatency_Ms"
+
+    # runtime conf audit (runtime/confaudit.py, armed at every
+    # StreamingHost / LiveQueryService init): keys audited against the
+    # conf registry, keys no registry row governs, and keys whose
+    # value violated its row's type/bounds — runtime DX1006, the
+    # dynamic half of the DX10xx configuration-lattice analyzer
+    CONF_AUDITED = "Conf_Audited_Count"
+    CONF_UNKNOWN = "Conf_Unknown_Count"
+    CONF_OUT_OF_BOUNDS = "Conf_OutOfBounds_Count"
     # delivery-conservation audit counters (obs/fleetview.py DX54x)
     DELIVERY_LOSS = "Conformance_Delivery_Loss_Count"
     DELIVERY_DUPLICATE = "Conformance_Delivery_Duplicate_Count"
@@ -164,6 +173,14 @@ class MetricName:
         # protocol analyzer
         r"Protocol_Events_Count",
         r"Protocol_Violation_Count",
+        # conf audit (runtime/confaudit.py, armed at host/LQ-service
+        # init): process-namespace keys audited against the typed conf
+        # registry (analysis/confspec.py), unknown keys, and
+        # type/bounds violations — runtime DX1006, the dynamic half of
+        # the DX10xx configuration-lattice analyzer
+        r"Conf_Audited_Count",
+        r"Conf_Unknown_Count",
+        r"Conf_OutOfBounds_Count",
         # device-resident result path (runtime/processor.py
         # collect_counts + runtime/host.py background landing): bytes
         # the blocking counts-only sync moved, landings still queued

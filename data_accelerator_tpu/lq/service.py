@@ -129,6 +129,17 @@ class LiveQueryService:
         )
         self.histograms = HistogramRegistry()
         self.metrics = MetricLogger(LQ_APP, store=store)
+        # boot-time conf audit (runtime/confaudit.py): a full prefixed
+        # conf handed to the service is replayed through the DX10xx
+        # lattice validator — DX1006 flight records + Conf_* gauges for
+        # unknown/out-of-bounds keys. Bare {key: value} dicts (the
+        # test-convenience form) carry no datax.job.process.* keys and
+        # audit as empty. Advisory: never blocks boot.
+        from ..runtime.confaudit import from_conf as _confaudit_from_conf
+
+        self.conf_audit = _confaudit_from_conf(
+            conf, subject="lq", metric_logger=self.metrics
+        )
         self._qps_window: List[float] = []  # completion stamps (10 s)
         self._qps_lock = threading.Lock()
         self._ticker: Optional[threading.Thread] = None

@@ -92,6 +92,19 @@ class StreamingHost:
         self.protocol_monitor = _protomon_from_conf(
             self.processor.process_conf.get_sub_dictionary("debug.")
         )
+        # boot-time conf audit (runtime/confaudit.py): the concrete conf
+        # this host started with, replayed through the DX10xx lattice
+        # validator — unknown/out-of-bounds keys flight-record DX1006
+        # (conf/violation events + Conf_* gauges) instead of being
+        # silently ignored. Advisory: never blocks boot.
+        from .confaudit import from_conf as _confaudit_from_conf
+
+        self.conf_audit = _confaudit_from_conf(
+            dict_,
+            subject="host",
+            telemetry=self.telemetry,
+            metric_logger=self.metric_logger,
+        )
 
         input_conf = dict_.get_sub_dictionary(SettingNamespace.JobInputPrefix)
         # one StreamingSource per declared input source (multi-source

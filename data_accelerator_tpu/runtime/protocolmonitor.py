@@ -142,5 +142,6 @@ class ProtocolMonitor:
 def from_conf(dbg_conf) -> Optional[ProtocolMonitor]:
     """``datax.job.process.debug.protocolmonitor=true`` arms the
     monitor (``dbg_conf`` is the ``debug.`` sub-dictionary)."""
+    # dx-conf: read debug.protocolmonitor default=false
     flag = (dbg_conf.get_or_else("protocolmonitor", "false") or "").lower()
     return ProtocolMonitor() if flag == "true" else None

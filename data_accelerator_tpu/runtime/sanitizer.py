@@ -213,5 +213,6 @@ class BufferSanitizer:
 def from_conf(dbg_conf) -> Optional[BufferSanitizer]:
     """``datax.job.process.debug.buffersanitizer=true`` arms the
     sanitizer (``dbg_conf`` is the ``debug.`` sub-dictionary)."""
+    # dx-conf: read debug.buffersanitizer default=false
     flag = (dbg_conf.get_or_else("buffersanitizer", "false") or "").lower()
     return BufferSanitizer() if flag == "true" else None

@@ -155,6 +155,18 @@ class FlowOperation:
 
         return analyze_flow_protocol(flow)
 
+    def validate_flow_conf(self, flow: dict):
+        """The conf tier of ``flow/validate`` (``conf: true``): the
+        DX10xx configuration-lattice gate — engine conf read sites and
+        generation-produced keys checked against the typed registry
+        (``analysis/confspec.py``), plus type/bounds (DX1004) and
+        incompatible-knob (DX1005) checks on THIS flow's effective
+        conf. Cached per engine-source state. Same implementation as
+        the CLI's ``--conf``; nothing executes."""
+        from ..analysis import analyze_flow_conf
+
+        return analyze_flow_conf(flow)
+
     def validate_flow_fleet(self, flow: dict, spec: Optional[dict] = None):
         """The fleet tier of ``flow/validate`` (``fleet: true``): the
         candidate flow is analyzed AS A SET with every currently

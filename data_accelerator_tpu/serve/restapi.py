@@ -298,6 +298,13 @@ class DataXApi:
         the engine modules plus the rescale handoff, merged into the
         diagnostics plus a ``protocol`` section (modules analyzed,
         effect events, pinned post-commit / requeue-upstream sites).
+        ``"conf": true`` adds the configuration-lattice tier (the
+        CLI's ``--conf``): the DX10xx conf lints — engine read sites
+        and generation-produced keys checked against the typed conf
+        registry, plus type/bounds and incompatible-knob checks on
+        THIS flow's effective conf — merged into the diagnostics plus
+        a ``conf`` section (modules scanned, read sites/keys, produced
+        keys, registry rows).
         ``"all": true`` runs every tier in one call — one merged report, one
         ``schemaVersion``, the CI single-invocation path."""
         flow = body.get("flow") or body.get("gui")
@@ -317,8 +324,10 @@ class DataXApi:
         want_mesh = all_tiers or body.get("mesh")
         want_race = all_tiers or body.get("race")
         want_protocol = all_tiers or body.get("protocol")
+        want_conf = all_tiers or body.get("conf")
         if not (want_device or want_udfs or want_fleet or want_compile
-                or want_mesh or want_race or want_protocol):
+                or want_mesh or want_race or want_protocol
+                or want_conf):
             return report.to_dict()
         from ..analysis import (
             ChipCountError,
@@ -362,9 +371,12 @@ class DataXApi:
             self.flow_ops.validate_flow_protocol(flow)
             if want_protocol else None
         )
+        conf = (
+            self.flow_ops.validate_flow_conf(flow) if want_conf else None
+        )
         return combined_report_dict(
             report, device, udfs, fleet, compile_surface=comp, mesh=mesh,
-            race=race, protocol=protocol,
+            race=race, protocol=protocol, conf=conf,
         )
 
     def _flow_generate(self, body, query):

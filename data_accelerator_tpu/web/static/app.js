@@ -282,6 +282,18 @@ route("#/flow/", async (view, hash) => {
       `${pc.postCommitSites} pinned post-commit site(s), ` +
       `${pc.requeueUpstreamSites} requeue-upstream site(s)`);
   };
+  const renderConfGate = (cf) => {
+    // conf tier (flow/validate conf: true): the DX10xx configuration
+    // lattice gate — engine read sites + generated keys checked
+    // against the typed conf registry, plus this flow's effective
+    // conf (merged DX10xx diagnostics render above)
+    if (!cf || !cf.analyzedFiles) return null;
+    return h("div", { class: "muted" },
+      `conf gate: ${cf.analyzedFiles} module(s) scanned — ` +
+      `${cf.readSites} read site(s) / ${cf.readKeys} key(s), ` +
+      `${cf.producedKeys} produced key(s), ` +
+      `${cf.registryKeys} registry row(s)`);
+  };
   const renderDiags = (r) => {
     diagBox.replaceChildren(
       h("div", { class: "muted" },
@@ -296,6 +308,7 @@ route("#/flow/", async (view, hash) => {
       renderCompileSurface(r.compile),
       renderRaceGate(r.race),
       renderProtocolGate(r.protocol),
+      renderConfGate(r.conf),
       renderCostTable(r.device),
       renderShardingTable(r.mesh),
       renderPlacement(r.fleet));

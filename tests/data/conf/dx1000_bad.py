@@ -1,0 +1,6 @@
+"""DX1000 bad twin: a runtime read of a conf key no registry row
+covers — the engine waits on a knob nothing can ever produce."""
+
+
+def configure(conf):
+    return conf.get("datax.job.process.ghost.widget")

@@ -1,0 +1,5 @@
+"""DX1000 clean twin: the same read shape against a registered key."""
+
+
+def configure(conf):
+    return conf.get("datax.job.process.batchcapacity")

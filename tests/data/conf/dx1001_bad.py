@@ -1,0 +1,6 @@
+"""DX1001 bad twin: a generated conf key no registry row covers —
+dead conf no runtime reader will ever see."""
+
+
+def produce(extra):
+    extra["datax.job.process.ghost.output"] = "1"

@@ -1,0 +1,6 @@
+"""DX1001 clean twin: the same producer shape writing a registered
+key."""
+
+
+def produce(extra):
+    extra["datax.job.process.pipeline.depth"] = "2"

@@ -1,0 +1,7 @@
+"""DX1002 bad twin: an S400-style gui token is built from a designer
+knob but no generated conf key ever carries it — the designer's choice
+is dropped on the floor (the PR 6 bug class)."""
+
+
+def tokens(jobconf):
+    return {"guiJobGhost": jobconf.get("jobGhost") or "1"}

@@ -1,0 +1,7 @@
+"""DX1002 clean twin: the token rides a generated key write, so the
+designer -> generation -> runtime chain is closed."""
+
+
+def produce(jobconf, extra):
+    tokens = {"guiJobGhost": jobconf.get("jobGhost") or "1"}
+    extra["datax.job.process.batchcapacity"] = tokens["guiJobGhost"]

@@ -1,0 +1,7 @@
+"""DX1003 bad twin: the read-site fallback literal disagrees with the
+registry's canonical default — 'unset' means different things on
+different layers."""
+
+
+def configure(conf):
+    return conf.get_or_else("datax.job.process.pipeline.depth", "3")

@@ -1,0 +1,6 @@
+"""DX1003 clean twin: the fallback literal agrees with the registry
+default."""
+
+
+def configure(conf):
+    return conf.get_or_else("datax.job.process.pipeline.depth", "2")

@@ -151,6 +151,7 @@ def test_golden_udf_diagnostic(fixture, code, severity):
 
 def test_every_registered_code_has_a_golden_fixture():
     from test_compilecheck import COMPILE_GOLDEN
+    from test_confcheck import CONF_CODES
     from test_fleetcheck import FLEET_GOLDEN
     from test_meshcheck import MESH_GOLDEN
     from test_protocheck import PROTO_CODES
@@ -165,6 +166,9 @@ def test_every_registered_code_has_a_golden_fixture():
         | {g[1] for g in MESH_GOLDEN}
         | set(RACE_CODES)
         | set(PROTO_CODES)
+        # DX1006 is the conf lattice's runtime half (runtime/confaudit
+        # ground truth lives in tests/test_confcheck.py, no static twin)
+        | set(CONF_CODES) | {"DX1006"}
     ) == set(CODES)
 
 
@@ -451,6 +455,15 @@ def test_json_reports_pin_schema_version_and_keys(tmp_path):
     }
     assert set(out["protocol"]["modules"][0]) == {
         "path", "functions", "events",
+    }
+
+    # conf tier (schemaVersion 5: the configuration-lattice gate)
+    out = json.loads(_run_cli(["--json", "--conf", path]).stdout)
+    assert out["schemaVersion"] == REPORT_SCHEMA_VERSION
+    assert set(out) == base_keys | {"file", "conf"}
+    assert set(out["conf"]) == {
+        "flow", "analyzedFiles", "readSites", "readKeys",
+        "producedKeys", "knobTokens", "registryKeys", "constraints",
     }
 
 

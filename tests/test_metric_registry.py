@@ -255,6 +255,19 @@ def test_protocol_monitor_metrics_are_registered():
     assert not MetricName.is_runtime_metric("Protocol_Bogus")
 
 
+def test_conf_audit_metrics_are_registered():
+    """The boot-time conf audit's series (runtime/confaudit.py, emitted
+    once at host/LQ-service init) resolve through the registry;
+    emission-side coverage is tests/test_confcheck.py."""
+    for m in (
+        "Conf_Audited_Count",
+        "Conf_Unknown_Count",
+        "Conf_OutOfBounds_Count",
+    ):
+        assert MetricName.is_runtime_metric(m), m
+    assert not MetricName.is_runtime_metric("Conf_Bogus")
+
+
 def test_lq_serving_metrics_are_registered():
     """Every LQ_* / Latency-LQExec series the LiveQuery serving plane
     emits (lq/service.py export_metrics under DATAX-LiveQuery) resolves

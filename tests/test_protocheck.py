@@ -241,7 +241,7 @@ def test_cli_protocol_json_and_all_fold_in():
     proc = _run_cli(["--protocol", "--json", path])
     assert proc.returncode == 0, proc.stderr
     report = json.loads(proc.stdout)
-    assert report["schemaVersion"] == REPORT_SCHEMA_VERSION == 4
+    assert report["schemaVersion"] == REPORT_SCHEMA_VERSION == 5
     assert report["protocol"]["analyzedFiles"] >= 24
     assert report["protocol"]["modules"]
     # --all includes the protocol block (one CI call, every tier)

@@ -414,7 +414,7 @@ def test_cli_race_json_and_all_fold_in():
     proc = _run_cli(["--race", "--json", path])
     assert proc.returncode == 0, proc.stderr
     report = json.loads(proc.stdout)
-    assert report["schemaVersion"] == REPORT_SCHEMA_VERSION == 4
+    assert report["schemaVersion"] == REPORT_SCHEMA_VERSION == 5
     assert report["race"]["analyzedFiles"] >= 15
     assert report["race"]["modules"]
     # --all includes the race block (one CI call, every tier); the
